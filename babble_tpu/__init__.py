@@ -1,0 +1,44 @@
+"""babble-tpu: a TPU-native hashgraph BFT consensus framework.
+
+A ground-up re-design of the capabilities of mpitid/babble (Leemon Baird's
+hashgraph virtual-voting consensus, packaged as transaction-ordering
+middleware) for TPU hardware via JAX/XLA.
+
+The key lift (see SURVEY.md §7): babble's per-event coordinate vectors
+(``lastAncestors`` / ``firstDescendants``, reference hashgraph/event.go:82-83)
+are already a latent ``(E, N)`` tensor formulation, and every consensus
+predicate is an elementwise/reduction op over them.  The Go reference
+evaluates these lazily, hash-by-hash, with LRU memoization; this framework
+evaluates them densely and in batch on TPU:
+
+- DAG reachability       -> int32 coordinate tensors in HBM
+- ``StronglySee``        -> blocked compare-count reductions
+- ``DecideFame`` voting  -> batched (R, W, W) vote matmuls on the MXU
+- median-timestamp order -> masked device sort
+
+The host side keeps babble's runtime shape — gossip transport with
+vector-clock diffs, node select loop, app proxies, /Stats service — rebuilt
+in asyncio + C++ rather than Go.
+
+Layout (mirrors SURVEY.md §2's component inventory):
+
+- ``common/``     LRU, RollingList            (reference common/)
+- ``crypto/``     ECDSA P-256, SHA-256, PEM   (reference crypto/)
+- ``core/``       Event model, wire format, host DAG index
+                                              (reference hashgraph/event.go)
+- ``consensus/``  oracle (reference-faithful) + TPU array engine
+                                              (reference hashgraph/hashgraph.go)
+- ``ops/``        the jitted JAX kernels
+- ``parallel/``   mesh sharding of the kernels (shard_map/pjit over ICI)
+- ``store/``      Store seam: inmem store + device state checkpointing
+                                              (reference hashgraph/store.go)
+- ``gossip/``     Transport iface, inmem + TCP transports, peers
+                                              (reference net/)
+- ``node/``       Node runtime, Core, peer selection
+                                              (reference node/)
+- ``proxy/``      App integration proxies     (reference proxy/)
+- ``service/``    /Stats HTTP endpoint        (reference service/)
+- ``sim/``        synthetic DAG generators, batch consensus benchmarks
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""Host-side foundational containers (reference: common/).
+
+These serve the host runtime only; device state lives in dense arrays
+(see ``babble_tpu.consensus.engine``).
+"""
+
+from .errors import KeyNotFoundError, TooLateError
+from .lru import LRU
+from .rolling_list import RollingList
+
+__all__ = ["LRU", "RollingList", "KeyNotFoundError", "TooLateError"]

@@ -1,0 +1,19 @@
+"""Consensus engines.
+
+Two implementations of the same hashgraph virtual-voting semantics
+(reference: hashgraph/hashgraph.go):
+
+- ``oracle.OracleHashgraph`` — a straight-line, hash-by-hash Python engine
+  faithful to the reference.  Slow, obviously correct; used as the
+  differential-test anchor and for tiny deployments.
+- ``engine.TpuHashgraph`` (forthcoming) — the TPU-native engine: dense
+  ``(E, N)`` coordinate tensors in device memory, jitted level-scans and
+  batched vote matmuls.  The production path.
+
+Both must produce identical consensus orders; the differential test suite
+enforces this once the TPU engine lands.
+"""
+
+from .oracle import OracleHashgraph
+
+__all__ = ["OracleHashgraph"]

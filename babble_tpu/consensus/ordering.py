@@ -1,0 +1,37 @@
+"""Final consensus ordering (reference: hashgraph/consensus_sorter.go).
+
+Events with a decided round-received are ordered by:
+1. round received,
+2. consensus (median) timestamp,
+3. whitened signature: S XOR PRN(roundReceived), where PRN is the XOR of the
+   round's famous-witness hashes (reference roundInfo.go:109-118).
+
+Divergence note: the reference's ConsensusSorter never populates its rounds
+map (consensus_sorter.go:26-32), so its PRN degenerates to 0 and the tiebreak
+is the raw signature scalar.  The reference's own tests accept either order
+(hashgraph_test.go:1034-1046); we implement the whitening as designed since
+it is deterministic across replicas either way.
+
+Shared by the oracle and the TPU engine so both produce bit-identical orders.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from ..core.event import Event
+
+
+def consensus_sort(events: List[Event], prn_for_round: Callable[[int], int]) -> List[Event]:
+    prn_cache = {}
+
+    def prn(r: int) -> int:
+        if r not in prn_cache:
+            prn_cache[r] = prn_for_round(r)
+        return prn_cache[r]
+
+    def key(e: Event):
+        rr = e.round_received if e.round_received is not None else -1
+        return (rr, e.consensus_timestamp, e.s ^ prn(rr))
+
+    return sorted(events, key=key)

@@ -1,0 +1,5 @@
+"""Event model and wire format (reference: hashgraph/event.go)."""
+
+from .event import Event, EventBody, WireEvent, new_event
+
+__all__ = ["Event", "EventBody", "WireEvent", "new_event"]

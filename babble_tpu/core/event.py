@@ -1,0 +1,223 @@
+"""Hashgraph events: the DAG's vertices.
+
+Reference parity (hashgraph/event.go):
+- EventBody{Transactions, Parents[self, other], Creator, Timestamp, Index}
+  (event.go:29-42) — here with int64-nanosecond timestamps.
+- SHA-256 identity hash over body+signature; hex id "0x..." (event.go:169-186).
+- ECDSA (r, s) signature over the body digest (event.go:131-150).
+- Compact WireEvent form referencing parents as (creatorID, index) ints
+  instead of 32-byte hashes (event.go:244-259) — "It is cheaper to send ints
+  then hashes over the wire".
+
+Encoding is a deterministic msgpack tuple, NOT Go gob: the wire format is
+ours, only the information content matches the reference.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import msgpack
+
+from ..crypto import keys as ck
+
+# Signature scalars are P-256 field elements: 32 bytes each.
+_SCALAR_BYTES = 32
+
+
+def _int_to_b32(v: int) -> bytes:
+    return v.to_bytes(_SCALAR_BYTES, "big")
+
+
+def middle_bit(hash_bytes: bytes) -> bool:
+    """Coin-flip bit for fame coin rounds: middle byte of an event's identity
+    hash non-zero (reference hashgraph.go:781-790 middleBit).  Single source
+    of truth shared by the Event model and both consensus engines."""
+    return hash_bytes[len(hash_bytes) // 2] != 0
+
+
+@dataclass
+class EventBody:
+    transactions: List[bytes]
+    self_parent: str      # hex id of creator's previous event, "" for first
+    other_parent: str     # hex id of the gossiped-from peer's head, "" for first
+    creator: bytes        # uncompressed SEC1 public key
+    timestamp: int        # creator's claimed creation time, int64 ns since epoch
+    index: int            # sequence number within creator's own chain
+
+    def canonical_bytes(self) -> bytes:
+        return msgpack.packb(
+            [
+                list(self.transactions),
+                self.self_parent,
+                self.other_parent,
+                self.creator,
+                self.timestamp,
+                self.index,
+            ],
+            use_bin_type=True,
+        )
+
+    def digest(self) -> bytes:
+        return ck.sha256(self.canonical_bytes())
+
+
+@dataclass
+class Event:
+    body: EventBody
+    r: Optional[int] = None
+    s: Optional[int] = None
+
+    # engine-assigned (mirrors the reference's hidden consensus fields,
+    # event.go:77-87)
+    topological_index: int = -1
+    round_received: Optional[int] = None
+    consensus_timestamp: Optional[int] = None
+
+    _hash: Optional[bytes] = field(default=None, repr=False)
+    _hex: Optional[str] = field(default=None, repr=False)
+    _creator_hex: Optional[str] = field(default=None, repr=False)
+
+    # --- identity ---------------------------------------------------------
+
+    @property
+    def creator(self) -> str:
+        if self._creator_hex is None:
+            self._creator_hex = "0x" + self.body.creator.hex().upper()
+        return self._creator_hex
+
+    @property
+    def self_parent(self) -> str:
+        return self.body.self_parent
+
+    @property
+    def other_parent(self) -> str:
+        return self.body.other_parent
+
+    @property
+    def index(self) -> int:
+        return self.body.index
+
+    @property
+    def transactions(self) -> List[bytes]:
+        return self.body.transactions
+
+    def hash(self) -> bytes:
+        """SHA-256 over body + signature (reference event.go:169-178)."""
+        if self._hash is None:
+            if self.r is None or self.s is None:
+                raise ValueError("event is unsigned")
+            self._hash = ck.sha256(
+                self.body.canonical_bytes() + _int_to_b32(self.r) + _int_to_b32(self.s)
+            )
+        return self._hash
+
+    def hex(self) -> str:
+        if self._hex is None:
+            self._hex = "0x" + self.hash().hex().upper()
+        return self._hex
+
+    def middle_bit(self) -> bool:
+        """Coin-flip bit for coin rounds (see module-level middle_bit)."""
+        return middle_bit(self.hash())
+
+    # --- crypto -----------------------------------------------------------
+
+    def sign(self, key: ck.KeyPair) -> None:
+        self.r, self.s = key.sign_digest(self.body.digest())
+        self._hash = None
+        self._hex = None
+
+    def verify(self) -> bool:
+        if self.r is None or self.s is None:
+            return False
+        try:
+            pub = ck.from_pub_bytes(self.body.creator)
+        except ValueError:
+            return False
+        return ck.verify(pub, self.body.digest(), self.r, self.s)
+
+    # --- wire -------------------------------------------------------------
+
+    def to_wire(
+        self, self_parent_index: int, other_parent_creator_id: int,
+        other_parent_index: int, creator_id: int,
+    ) -> "WireEvent":
+        return WireEvent(
+            transactions=list(self.body.transactions),
+            self_parent_index=self_parent_index,
+            other_parent_creator_id=other_parent_creator_id,
+            other_parent_index=other_parent_index,
+            creator_id=creator_id,
+            timestamp=self.body.timestamp,
+            index=self.body.index,
+            r=self.r,
+            s=self.s,
+        )
+
+
+@dataclass
+class WireEvent:
+    """Compact wire form: parents as (creatorID, index) ints (event.go:244-259)."""
+
+    transactions: List[bytes]
+    self_parent_index: int
+    other_parent_creator_id: int
+    other_parent_index: int
+    creator_id: int
+    timestamp: int
+    index: int
+    r: int
+    s: int
+
+    def pack(self) -> list:
+        return [
+            list(self.transactions),
+            self.self_parent_index,
+            self.other_parent_creator_id,
+            self.other_parent_index,
+            self.creator_id,
+            self.timestamp,
+            self.index,
+            _int_to_b32(self.r),
+            _int_to_b32(self.s),
+        ]
+
+    @classmethod
+    def unpack(cls, obj: list) -> "WireEvent":
+        (txs, spi, opc, opi, cid, ts, idx, r, s) = obj
+        return cls(
+            transactions=[bytes(t) for t in txs],
+            self_parent_index=spi,
+            other_parent_creator_id=opc,
+            other_parent_index=opi,
+            creator_id=cid,
+            timestamp=ts,
+            index=idx,
+            r=int.from_bytes(r, "big"),
+            s=int.from_bytes(s, "big"),
+        )
+
+
+def new_event(
+    transactions: List[bytes],
+    parents: Tuple[str, str],
+    creator_pub: bytes,
+    index: int,
+    timestamp: Optional[int] = None,
+) -> Event:
+    """Mirror of NewEvent (reference event.go:90-105); timestamp defaults to
+    now in int64 nanoseconds."""
+    if timestamp is None:
+        timestamp = time.time_ns()
+    body = EventBody(
+        transactions=list(transactions),
+        self_parent=parents[0],
+        other_parent=parents[1],
+        creator=creator_pub,
+        timestamp=timestamp,
+        index=index,
+    )
+    return Event(body=body)

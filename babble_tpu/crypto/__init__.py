@@ -1,0 +1,31 @@
+"""ECDSA P-256 / SHA-256 primitives and PEM key files (reference: crypto/).
+
+Mirrors the reference surface (crypto/utils.go:26-58, crypto/pem_key.go:33-108):
+key generation, sign/verify over SHA-256 digests with raw (r, s) signature
+scalars, uncompressed SEC1 public-key marshalling, and a datadir PEM key file
+convention (``priv_key.pem``).
+"""
+
+from .keys import (
+    KeyPair,
+    PemKeyFile,
+    from_pub_bytes,
+    generate_key,
+    pub_bytes,
+    pub_hex,
+    sha256,
+    sign,
+    verify,
+)
+
+__all__ = [
+    "KeyPair",
+    "PemKeyFile",
+    "generate_key",
+    "sha256",
+    "sign",
+    "verify",
+    "pub_bytes",
+    "pub_hex",
+    "from_pub_bytes",
+]

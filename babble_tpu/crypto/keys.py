@@ -1,0 +1,146 @@
+"""ECDSA P-256 keys, signatures and PEM files.
+
+Reference parity:
+- crypto/utils.go:26-33   SHA256
+- crypto/utils.go:35-44   GenerateECDSAKey / Sign / Verify (raw r, s scalars)
+- crypto/utils.go:46-58   To/FromECDSAPub (uncompressed SEC1 point)
+- crypto/pem_key.go       PEM key file read/write in a datadir
+
+Implementation uses the `cryptography` hazmat layer rather than a hand-rolled
+curve; signatures are exchanged as raw (r, s) integer pairs exactly like the
+reference wire format, not DER.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Tuple
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives.hashes import SHA256
+
+_CURVE = ec.SECP256R1()
+_PREHASHED = ec.ECDSA(Prehashed(SHA256()))
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+@dataclass
+class KeyPair:
+    """An ECDSA P-256 private key plus cached public encodings."""
+
+    private: ec.EllipticCurvePrivateKey
+
+    @property
+    def public(self) -> ec.EllipticCurvePublicKey:
+        return self.private.public_key()
+
+    @property
+    def pub_bytes(self) -> bytes:
+        return pub_bytes(self.public)
+
+    @property
+    def pub_hex(self) -> str:
+        return pub_hex(self.public)
+
+    def sign_digest(self, digest: bytes) -> Tuple[int, int]:
+        return sign(self.private, digest)
+
+
+def generate_key() -> KeyPair:
+    return KeyPair(ec.generate_private_key(_CURVE))
+
+
+def sign(private: ec.EllipticCurvePrivateKey, digest: bytes) -> Tuple[int, int]:
+    """Sign a 32-byte SHA-256 digest; returns raw (r, s) scalars."""
+    der = private.sign(digest, _PREHASHED)
+    return decode_dss_signature(der)
+
+
+def verify(public: ec.EllipticCurvePublicKey, digest: bytes, r: int, s: int) -> bool:
+    try:
+        public.verify(encode_dss_signature(r, s), digest, _PREHASHED)
+        return True
+    except InvalidSignature:
+        return False
+    except ValueError:
+        return False
+
+
+def pub_bytes(public: ec.EllipticCurvePublicKey) -> bytes:
+    """Uncompressed SEC1 point (0x04 || X || Y), 65 bytes — the reference's
+    elliptic.Marshal encoding (crypto/utils.go:46-49)."""
+    return public.public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+    )
+
+
+def pub_hex(public: ec.EllipticCurvePublicKey) -> str:
+    """'0x' + upper-hex of the SEC1 point — the participant identity string
+    (reference event.go:107-112 Creator())."""
+    return "0x" + pub_bytes(public).hex().upper()
+
+
+def from_pub_bytes(data: bytes) -> ec.EllipticCurvePublicKey:
+    return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, data)
+
+
+def pub_hex_to_bytes(hex_id: str) -> bytes:
+    if hex_id.startswith("0x") or hex_id.startswith("0X"):
+        hex_id = hex_id[2:]
+    return bytes.fromhex(hex_id)
+
+
+class PemKeyFile:
+    """priv_key.pem in a datadir (reference crypto/pem_key.go:29-31)."""
+
+    FILENAME = "priv_key.pem"
+
+    def __init__(self, datadir: str):
+        self.path = os.path.join(datadir, self.FILENAME)
+
+    def read(self) -> KeyPair:
+        with open(self.path, "rb") as f:
+            key = serialization.load_pem_private_key(f.read(), password=None)
+        if not isinstance(key, ec.EllipticCurvePrivateKey):
+            raise ValueError("priv_key.pem does not contain an EC private key")
+        return KeyPair(key)
+
+    def write(self, key: KeyPair) -> None:
+        pem = key.private.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "wb") as f:
+            f.write(pem)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+
+def pem_dump(key: KeyPair) -> Tuple[str, str]:
+    """(private_pem, public_pem) strings — the `keygen` CLI output
+    (reference cmd/main.go keygen + crypto/pem_key.go GeneratePemKey)."""
+    priv = key.private.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    ).decode()
+    pub = key.public.public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    ).decode()
+    return priv, pub

@@ -1,0 +1,12 @@
+"""Persistence seam for events, rounds and the consensus log (reference: hashgraph/store.go).
+
+The reference defines a 14-method Store interface with a single in-memory
+implementation backed by LRU + rolling windows; this package provides the
+same seam for the host side.  Device-side consensus state (the dense
+coordinate tensors) is managed by ``babble_tpu.consensus.engine`` and
+checkpointed via ``babble_tpu.store.checkpoint``.
+"""
+
+from .inmem import InmemStore, RoundEvent, RoundInfo, Store
+
+__all__ = ["Store", "InmemStore", "RoundInfo", "RoundEvent"]

@@ -1,0 +1,198 @@
+"""In-memory store: LRU events/rounds + rolling consensus log + per-creator
+event sequences (reference: hashgraph/inmem_store.go, hashgraph/caches.go,
+hashgraph/roundInfo.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol
+
+from ..common import LRU, KeyNotFoundError, RollingList, TooLateError
+from ..core.event import Event
+
+
+@dataclass
+class RoundEvent:
+    """Witness flag + fame trilean for one event in a round
+    (reference roundInfo.go:38-41; Famous None=Undefined/True/False)."""
+
+    witness: bool = False
+    famous: Optional[bool] = None
+
+
+@dataclass
+class RoundInfo:
+    """Per-round event map (reference roundInfo.go:43-118)."""
+
+    events: Dict[str, RoundEvent] = field(default_factory=dict)
+
+    def add_event(self, x: str, witness: bool) -> None:
+        if x not in self.events:
+            self.events[x] = RoundEvent(witness=witness)
+
+    def set_fame(self, x: str, famous: bool) -> None:
+        ev = self.events.get(x)
+        if ev is None:
+            ev = RoundEvent(witness=True)
+            self.events[x] = ev
+        ev.famous = famous
+
+    def witnesses_decided(self) -> bool:
+        return all(
+            not e.witness or e.famous is not None for e in self.events.values()
+        )
+
+    def witnesses(self) -> List[str]:
+        return [x for x, e in self.events.items() if e.witness]
+
+    def famous_witnesses(self) -> List[str]:
+        return [x for x, e in self.events.items() if e.witness and e.famous is True]
+
+    def pseudo_random_number(self) -> int:
+        """XOR of famous witness hashes (reference roundInfo.go:109-118) —
+        the whitening seed for the signature tiebreak."""
+        res = 0
+        for x in self.famous_witnesses():
+            res ^= int(x, 16)
+        return res
+
+
+class Store(Protocol):
+    """The 14-method persistence seam (reference store.go:25-41)."""
+
+    def cache_size(self) -> int: ...
+    def get_event(self, key: str) -> Event: ...
+    def set_event(self, event: Event) -> None: ...
+    def participant_events(self, participant: str, skip: int) -> List[str]: ...
+    def participant_event(self, participant: str, index: int) -> str: ...
+    def last_from(self, participant: str) -> str: ...
+    def known(self) -> Dict[int, int]: ...
+    def consensus_events(self) -> List[str]: ...
+    def consensus_events_count(self) -> int: ...
+    def add_consensus_event(self, key: str) -> None: ...
+    def get_round(self, r: int) -> RoundInfo: ...
+    def set_round(self, r: int, info: RoundInfo) -> None: ...
+    def rounds(self) -> int: ...
+    def round_witnesses(self, r: int) -> List[str]: ...
+    def round_events(self, r: int) -> int: ...
+
+
+class _ParticipantEventsCache:
+    """participant -> RollingList of event hashes (reference caches.go:20-115)."""
+
+    def __init__(self, size: int, participants: Dict[str, int]):
+        self.size = size
+        self.participants = participants
+        self._events: Dict[str, RollingList] = {
+            pk: RollingList(size) for pk in participants
+        }
+
+    def get(self, participant: str, skip: int) -> List[str]:
+        pe = self._events.get(participant)
+        if pe is None:
+            raise KeyNotFoundError(participant)
+        cached, tot = pe.get()
+        if skip >= tot:
+            return []
+        oldest_cached = tot - len(cached)
+        if skip < oldest_cached:
+            # Reference leaves disk spill unimplemented (caches.go:59-61);
+            # callers treat this as "peer must catch up elsewhere".
+            raise TooLateError(skip)
+        start = skip - oldest_cached
+        return list(cached[start:])
+
+    def get_item(self, participant: str, index: int) -> str:
+        pe = self._events.get(participant)
+        if pe is None:
+            raise KeyNotFoundError(participant)
+        return pe.get_item(index)
+
+    def get_last(self, participant: str) -> str:
+        pe = self._events.get(participant)
+        if pe is None:
+            raise KeyNotFoundError(participant)
+        cached, _ = pe.get()
+        return cached[-1] if cached else ""
+
+    def add(self, participant: str, hash_: str) -> None:
+        pe = self._events.setdefault(participant, RollingList(self.size))
+        pe.add(hash_)
+
+    def known(self) -> Dict[int, int]:
+        return {
+            self.participants[p]: evs.get()[1] for p, evs in self._events.items()
+        }
+
+
+class InmemStore:
+    """Sole host-side Store implementation (reference inmem_store.go:20-142)."""
+
+    def __init__(self, participants: Dict[str, int], cache_size: int):
+        self._cache_size = cache_size
+        self._event_cache = LRU(cache_size)
+        self._round_cache = LRU(cache_size)
+        self._consensus_cache = RollingList(cache_size)
+        self._participant_events = _ParticipantEventsCache(cache_size, participants)
+
+    def cache_size(self) -> int:
+        return self._cache_size
+
+    def get_event(self, key: str) -> Event:
+        ev, ok = self._event_cache.get(key)
+        if not ok:
+            raise KeyNotFoundError(key)
+        return ev
+
+    def set_event(self, event: Event) -> None:
+        key = event.hex()
+        if key not in self._event_cache:
+            self._participant_events.add(event.creator, key)
+        self._event_cache.add(key, event)
+
+    def participant_events(self, participant: str, skip: int) -> List[str]:
+        return self._participant_events.get(participant, skip)
+
+    def participant_event(self, participant: str, index: int) -> str:
+        return self._participant_events.get_item(participant, index)
+
+    def last_from(self, participant: str) -> str:
+        return self._participant_events.get_last(participant)
+
+    def known(self) -> Dict[int, int]:
+        return self._participant_events.known()
+
+    def consensus_events(self) -> List[str]:
+        window, _ = self._consensus_cache.get()
+        return list(window)
+
+    def consensus_events_count(self) -> int:
+        return self._consensus_cache.total
+
+    def add_consensus_event(self, key: str) -> None:
+        self._consensus_cache.add(key)
+
+    def get_round(self, r: int) -> RoundInfo:
+        info, ok = self._round_cache.get(r)
+        if not ok:
+            raise KeyNotFoundError(r)
+        return info
+
+    def set_round(self, r: int, info: RoundInfo) -> None:
+        self._round_cache.add(r, info)
+
+    def rounds(self) -> int:
+        return len(self._round_cache)
+
+    def round_witnesses(self, r: int) -> List[str]:
+        try:
+            return self.get_round(r).witnesses()
+        except KeyNotFoundError:
+            return []
+
+    def round_events(self, r: int) -> int:
+        try:
+            return len(self.get_round(r).events)
+        except KeyNotFoundError:
+            return 0

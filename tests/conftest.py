@@ -1,0 +1,15 @@
+"""Test configuration: force a virtual 8-device CPU platform BEFORE jax import.
+
+Multi-chip sharding tests run on a simulated 8-device CPU mesh
+(xla_force_host_platform_device_count); real-TPU execution is exercised by
+bench.py and the driver's graft entry, not the unit tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

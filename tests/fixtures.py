@@ -1,0 +1,185 @@
+"""Named-DAG fixtures mirroring the reference test hashgraphs.
+
+The reference builds miniature DAGs with ASCII-art documentation and asserts
+exact predicate values by event name (hashgraph/hashgraph_test.go:66-129,
+310-369, 795-950).  We reproduce the same shapes through a play-script
+builder; assertions in the tests reference the same names.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from babble_tpu.core.event import Event, new_event
+from babble_tpu.crypto.keys import KeyPair, generate_key
+
+
+@dataclass
+class FixtureNode:
+    id: int
+    key: KeyPair
+
+    @property
+    def pub(self) -> bytes:
+        return self.key.pub_bytes
+
+    @property
+    def pub_hex(self) -> str:
+        return self.key.pub_hex
+
+
+@dataclass
+class Fixture:
+    nodes: List[FixtureNode]
+    participants: Dict[str, int]          # pub hex -> id
+    index: Dict[str, str]                 # event name -> hex id
+    names: Dict[str, str]                 # hex id -> event name
+    ordered_events: List[Event]           # insertion (topological) order
+    events_by_name: Dict[str, Event]
+
+    def name_of(self, hex_id: str) -> str:
+        return self.names.get(hex_id, hex_id[:12])
+
+
+# Each play: (name, creator_id, self_parent_name, other_parent_name, txs)
+Play = Tuple[str, int, str, str, List[bytes]]
+
+
+def build_fixture(n: int, plays: List[Play], base_ts: int = 1_000_000_000_000_000_000) -> Fixture:
+    """Build a named DAG.  Timestamps increase by 1us per event in insertion
+    order so medians are deterministic in tests (the reference relies on
+    wall-clock time.Now() ordering the same way)."""
+    nodes = [FixtureNode(i, generate_key()) for i in range(n)]
+    participants = {node.pub_hex: node.id for node in nodes}
+    index: Dict[str, str] = {}
+    names: Dict[str, str] = {}
+    ordered: List[Event] = []
+    by_name: Dict[str, Event] = {}
+    seqs = [0] * n
+
+    for k, (name, creator, sp_name, op_name, txs) in enumerate(plays):
+        sp = index[sp_name] if sp_name else ""
+        op = index[op_name] if op_name else ""
+        ev = new_event(
+            txs,
+            (sp, op),
+            nodes[creator].pub,
+            seqs[creator],
+            timestamp=base_ts + k * 1000,
+        )
+        ev.sign(nodes[creator].key)
+        seqs[creator] += 1
+        index[name] = ev.hex()
+        names[ev.hex()] = name
+        ordered.append(ev)
+        by_name[name] = ev
+
+    return Fixture(nodes, participants, index, names, ordered, by_name)
+
+
+def simple_fixture() -> Fixture:
+    """5-event DAG (reference hashgraph_test.go:66-77)::
+
+        |  e12  |
+        |   | \\ |
+        |   |   e20
+        |   | / |
+        |   /   |
+        | / |   |
+        e01 |   |
+        | \\ |   |
+        e0  e1  e2
+        0   1   2
+    """
+    plays = [
+        ("e0", 0, "", "", []),
+        ("e1", 1, "", "", []),
+        ("e2", 2, "", "", []),
+        ("e01", 0, "e0", "e1", []),
+        ("e20", 2, "e2", "e01", []),
+        ("e12", 1, "e1", "e20", []),
+    ]
+    return build_fixture(3, plays)
+
+
+def round_fixture() -> Fixture:
+    """7-event DAG (reference hashgraph_test.go:310-323)::
+
+        |   f1  |
+        |  /|   |
+        e02 |   |
+        | \\ |   |
+        |   \\   |
+        |   | \\ |
+        |   |  e21
+        |   | / |
+        |  e10  |
+        | / |   |
+        e0  e1  e2
+        0   1    2
+    """
+    plays = [
+        ("e0", 0, "", "", []),
+        ("e1", 1, "", "", []),
+        ("e2", 2, "", "", []),
+        ("e10", 1, "e1", "e0", []),
+        ("e21", 2, "e2", "e10", []),
+        ("e02", 0, "e0", "e21", []),
+        ("f1", 1, "e10", "e02", []),
+    ]
+    return build_fixture(3, plays)
+
+
+def consensus_fixture() -> Fixture:
+    """21-event, 3-round DAG (reference hashgraph_test.go:795-834).  The
+    repeating motif per round r in {e, f, g, h}:
+
+        r0  |   r2
+        | \\ | / |
+        |   r1  |
+        |  /|   |
+        q02 |   |      (q = previous round's letter)
+        | \\ |   |
+        |   \\   |
+        |   | \\ |
+        |   |  q21
+        |   | / |
+        |  q10  |
+        | / |   |
+        q0  |   q2
+    """
+    plays = [
+        ("e0", 0, "", "", []),
+        ("e1", 1, "", "", []),
+        ("e2", 2, "", "", []),
+        ("e10", 1, "e1", "e0", []),
+        ("e21", 2, "e2", "e10", []),
+        ("e02", 0, "e0", "e21", []),
+        ("f1", 1, "e10", "e02", []),
+        ("f0", 0, "e02", "f1", []),
+        ("f2", 2, "e21", "f1", []),
+        ("f10", 1, "f1", "f0", []),
+        ("f21", 2, "f2", "f10", []),
+        ("f02", 0, "f0", "f21", []),
+        ("g1", 1, "f10", "f02", []),
+        ("g0", 0, "f02", "g1", []),
+        ("g2", 2, "f21", "g1", []),
+        ("g10", 1, "g1", "g0", []),
+        ("g21", 2, "g2", "g10", []),
+        ("g02", 0, "g0", "g21", []),
+        ("h1", 1, "g10", "g02", []),
+        ("h0", 0, "g02", "h1", []),
+        ("h2", 2, "g21", "h1", []),
+    ]
+    return build_fixture(3, plays)
+
+
+def oracle_from_fixture(fixture: Fixture, cache_size: int = 100):
+    """Insert all fixture events into a fresh oracle engine."""
+    from babble_tpu.consensus.oracle import OracleHashgraph
+    from babble_tpu.store.inmem import InmemStore
+
+    store = InmemStore(fixture.participants, cache_size)
+    h = OracleHashgraph(participants=fixture.participants, store=store)
+    for ev in fixture.ordered_events:
+        h.insert_event(ev)
+    return h
