@@ -1,0 +1,347 @@
+"""TpuHashgraph: the TPU-native consensus engine.
+
+Host/device split:
+- Host (``core.dag.HostDag``): hash<->slot index, signature + fork
+  validation, wire conversion, level scheduling, final sort + commit.
+- Device (``ops.*``): dense coordinate tensors and the jitted pipeline —
+  ingest (coordinates + rounds), decide_fame (vote matmuls), decide_order
+  (round-received + median timestamps).
+
+API mirrors the reference Hashgraph (hashgraph/hashgraph.go) and the
+pure-Python oracle so the two engines are drop-in interchangeable:
+insert_event / divide_rounds / decide_fame / find_order / run_consensus,
+plus the predicate surface (ancestor, strongly_see, round, witness, ...)
+used by tests and the node runtime.
+
+Batching: insert_event only indexes host-side; device ingestion happens
+lazily at the next consensus call (or explicit flush), so a gossip sync's
+worth of events rides one kernel launch.  Shapes are bucketed to powers of
+two to bound recompilation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dag import HostDag, InsertError
+from ..core.event import Event, WireEvent
+from ..ops import fame as fame_ops
+from ..ops import ingest as ingest_ops
+from ..ops import order as order_ops
+from ..ops.state import (
+    FAME_TRUE,
+    FAME_UNDEFINED,
+    INT32_MAX,
+    DagConfig,
+    DagState,
+    grow_state,
+    init_state,
+)
+
+_FD_FULL_THRESHOLD = 2048  # batch size above which full FD recompute wins
+
+
+def _bucket(x: int, minimum: int = 8) -> int:
+    v = max(x, minimum)
+    return 1 << (v - 1).bit_length()
+
+
+class TpuHashgraph:
+    def __init__(
+        self,
+        participants: Dict[str, int],
+        commit_callback: Optional[Callable[[List[Event]], None]] = None,
+        verify_signatures: bool = True,
+        e_cap: int = 4096,
+        s_cap: int = 1024,
+        r_cap: int = 64,
+    ):
+        n = len(participants)
+        self.participants = participants
+        self.commit_callback = commit_callback
+        self.dag = HostDag(participants, verify_signatures=verify_signatures)
+        self.cfg = DagConfig(n=n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap)
+        self.state: DagState = init_state(self.cfg)
+
+        self.consensus: List[str] = []            # hex ids in consensus order
+        self.consensus_transactions = 0
+        self.last_committed_round_events = 0
+        self._received: set = set()               # slots already ordered
+        self._view: Dict[str, np.ndarray] = {}    # host cache of device arrays
+
+    # ------------------------------------------------------------------
+    # properties mirroring the oracle/reference
+
+    @property
+    def n(self) -> int:
+        return self.cfg.n
+
+    def super_majority(self) -> int:
+        return self.cfg.super_majority
+
+    @property
+    def last_consensus_round(self) -> Optional[int]:
+        self.flush()
+        lcr = int(self.state.lcr)
+        return None if lcr < 0 else lcr
+
+    @property
+    def undetermined_count(self) -> int:
+        self.flush()
+        return self.dag.n_events - len(self._received)
+
+    # ------------------------------------------------------------------
+    # ingestion
+
+    def insert_event(self, event: Event) -> None:
+        self.dag.insert(event)
+
+    def flush(self) -> None:
+        """Push pending host events through the device ingest pipeline."""
+        if not self.dag.pending:
+            return
+        k = len(self.dag.pending)
+        self._ensure_capacity(k)
+        sp, op, creator, seq, ts, mbit, sched = self.dag.take_pending()
+
+        kpad = _bucket(k)
+        t, b = sched.shape
+        tpad, bpad = _bucket(t, 1), _bucket(b, 1)
+
+        def pad1(a, fill, dtype):
+            out = np.full(kpad, fill, dtype)
+            out[:k] = a
+            return out
+
+        sched_p = np.full((tpad, bpad), -1, np.int32)
+        sched_p[:t, :b] = sched
+
+        batch = ingest_ops.EventBatch(
+            sp=jnp.asarray(pad1(sp, -1, np.int32)),
+            op=jnp.asarray(pad1(op, -1, np.int32)),
+            creator=jnp.asarray(pad1(creator, 0, np.int32)),
+            seq=jnp.asarray(pad1(seq, 0, np.int32)),
+            ts=jnp.asarray(pad1(ts, 0, np.int64)),
+            mbit=jnp.asarray(pad1(mbit, False, bool)),
+            k=jnp.asarray(k, jnp.int32),
+            sched=jnp.asarray(sched_p),
+        )
+        fd_mode = "full" if k > _FD_FULL_THRESHOLD else "incremental"
+        self.state = ingest_ops.ingest(self.cfg, self.state, fd_mode, batch)
+        self._view = {}
+
+    def _ensure_capacity(self, k_new: int) -> None:
+        cfg = self.cfg
+        need_e = self.dag.n_events  # host already includes pending
+        max_chain = max((len(c) for c in self.dag.chains), default=0)
+        # each new topological level can raise the max round by at most 1
+        levels_new = len({self.dag.levels[s] for s in self.dag.pending})
+        need_r = max(int(self.state.max_round), 0) + levels_new + 2
+
+        e_cap, s_cap, r_cap = cfg.e_cap, cfg.s_cap, cfg.r_cap
+        while need_e > e_cap:
+            e_cap *= 2
+        while max_chain >= s_cap:
+            s_cap *= 2
+        while need_r >= r_cap:
+            r_cap *= 2
+        if (e_cap, s_cap, r_cap) != (cfg.e_cap, cfg.s_cap, cfg.r_cap):
+            new_cfg = DagConfig(n=cfg.n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap)
+            self.state = grow_state(self.state, cfg, new_cfg)
+            self.cfg = new_cfg
+            self._view = {}
+
+    # ------------------------------------------------------------------
+    # consensus pipeline
+
+    def divide_rounds(self) -> None:
+        # rounds are assigned during ingest; dividing == flushing
+        self.flush()
+
+    def decide_fame(self) -> None:
+        self.flush()
+        self.state = fame_ops.decide_fame(self.cfg, self.state)
+        self._view = {}
+
+    def find_order(self) -> List[Event]:
+        self.flush()
+        self.state = order_ops.decide_order(self.cfg, self.state)
+        self._view = {}
+
+        rr = self._arr("rr")
+        cts = self._arr("cts")
+        ne = self.dag.n_events
+        new_slots = [
+            s for s in range(ne) if rr[s] >= 0 and s not in self._received
+        ]
+        if not new_slots:
+            return []
+
+        new_events: List[Event] = []
+        for s in new_slots:
+            ev = self.dag.events[s]
+            ev.round_received = int(rr[s])
+            ev.consensus_timestamp = int(cts[s])
+            new_events.append(ev)
+            self._received.add(s)
+
+        from .ordering import consensus_sort
+
+        new_events = consensus_sort(new_events, self._round_prn)
+        for ev in new_events:
+            self.consensus.append(ev.hex())
+            self.consensus_transactions += len(ev.transactions)
+
+        lcr = int(self.state.lcr)
+        if lcr >= 1:
+            rounds = self._arr("round")
+            self.last_committed_round_events = int(
+                np.count_nonzero(rounds[:ne] == lcr - 1)
+            )
+
+        if self.commit_callback is not None and new_events:
+            self.commit_callback(new_events)
+        return new_events
+
+    def run_consensus(self) -> List[Event]:
+        self.divide_rounds()
+        self.decide_fame()
+        return self.find_order()
+
+    def _round_prn(self, r: int) -> int:
+        """Whitening seed: XOR of the round's famous-witness hashes
+        (reference roundInfo.go:109-118)."""
+        if r < 0 or r >= self.cfg.r_cap:
+            return 0
+        wslot = self._arr("wslot")
+        famous = self._arr("famous")
+        res = 0
+        for j in range(self.n):
+            if wslot[r, j] >= 0 and famous[r, j] == FAME_TRUE:
+                res ^= int(self.dag.events[int(wslot[r, j])].hex(), 16)
+        return res
+
+    # ------------------------------------------------------------------
+    # wire conversion passthrough
+
+    def to_wire(self, event: Event) -> WireEvent:
+        return self.dag.to_wire(event)
+
+    def read_wire_info(self, wevent: WireEvent) -> Event:
+        return self.dag.read_wire_info(wevent)
+
+    # ------------------------------------------------------------------
+    # predicate surface (host queries against device arrays; test + runtime)
+
+    def _arr(self, name: str) -> np.ndarray:
+        if name not in self._view:
+            self._view[name] = np.asarray(getattr(self.state, name))
+        return self._view[name]
+
+    def _slot(self, x: str) -> int:
+        s = self.dag.slot_of.get(x, -1)
+        if s < 0:
+            raise KeyError(x)
+        return s
+
+    def ancestor(self, x: str, y: str) -> bool:
+        if x == "" or y == "":
+            return False
+        if x == y:
+            return True
+        self.flush()
+        try:
+            sx, sy = self._slot(x), self._slot(y)
+        except KeyError:
+            return False
+        la = self._arr("la")
+        cy = self.participants[self.dag.events[sy].creator]
+        return bool(la[sx, cy] >= self.dag.events[sy].index)
+
+    def see(self, x: str, y: str) -> bool:
+        return self.ancestor(x, y)
+
+    def self_ancestor(self, x: str, y: str) -> bool:
+        if x == "" or y == "":
+            return False
+        if x == y:
+            return True
+        try:
+            ex = self.dag.events[self._slot(x)]
+            ey = self.dag.events[self._slot(y)]
+        except KeyError:
+            return False
+        return ex.creator == ey.creator and ex.index >= ey.index
+
+    def strongly_see(self, x: str, y: str) -> bool:
+        self.flush()
+        try:
+            sx, sy = self._slot(x), self._slot(y)
+        except KeyError:
+            return False
+        la, fd = self._arr("la"), self._arr("fd")
+        return int(np.count_nonzero(la[sx] >= fd[sy])) >= self.super_majority()
+
+    def oldest_self_ancestor_to_see(self, x: str, y: str) -> str:
+        self.flush()
+        try:
+            sx, sy = self._slot(x), self._slot(y)
+        except KeyError:
+            return ""
+        fd = self._arr("fd")
+        ex = self.dag.events[sx]
+        j = self.participants[ex.creator]
+        f = int(fd[sy, j])
+        if f <= ex.index and f != int(INT32_MAX):
+            return self.dag.events[self.dag.chains[j][f]].hex()
+        return ""
+
+    def round(self, x: str) -> int:
+        self.flush()
+        return int(self._arr("round")[self._slot(x)])
+
+    def witness(self, x: str) -> bool:
+        self.flush()
+        return bool(self._arr("witness")[self._slot(x)])
+
+    def round_witnesses(self, r: int) -> List[str]:
+        self.flush()
+        wslot = self._arr("wslot")
+        if r < 0 or r >= self.cfg.r_cap:
+            return []
+        return [
+            self.dag.events[int(s)].hex() for s in wslot[r] if s >= 0
+        ]
+
+    def famous_of(self, r: int, x: str) -> Optional[bool]:
+        """Fame trilean of witness x in round r (None = undecided)."""
+        self.flush()
+        if r < 0 or r >= self.cfg.r_cap:
+            return None
+        wslot = self._arr("wslot")
+        famous = self._arr("famous")
+        sx = self._slot(x)
+        for j in range(self.n):
+            if wslot[r, j] == sx:
+                f = famous[r, j]
+                return None if f == FAME_UNDEFINED else bool(f == FAME_TRUE)
+        return None
+
+    def rounds(self) -> int:
+        self.flush()
+        return int(self.state.max_round) + 1
+
+    # ------------------------------------------------------------------
+
+    def known(self) -> Dict[int, int]:
+        return self.dag.known()
+
+    def consensus_events(self) -> List[str]:
+        return list(self.consensus)
+
+    def consensus_events_count(self) -> int:
+        return len(self.consensus)

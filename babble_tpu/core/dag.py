@@ -1,0 +1,213 @@
+"""Host-side DAG index: slot assignment, validation, levels, batch building.
+
+The host mirror of the device state — the piece of the reference Store that
+must stay CPU-side (hash <-> slot resolution, signature checks, per-creator
+chains for wire conversion).  Device slots are insertion order on this
+replica; consensus outputs are replica-invariant because ordering keys
+(round-received, median timestamp, whitened signature) don't depend on slots.
+
+Insert validation mirrors FromParentsLatest (reference hashgraph.go:366-396):
+parents must exist and the self-parent must be the creator's latest event —
+the implicit fork rejection.
+
+Levels: level(x) = 1 + max(level(sp), level(op)), 0 for roots.  Events of one
+level are mutually non-ancestral, which is what lets the device kernels
+process a level per step (see ops/ingest.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..crypto.keys import pub_hex_to_bytes
+from .event import Event, EventBody, WireEvent
+
+
+class InsertError(ValueError):
+    pass
+
+
+@dataclass
+class HostDag:
+    participants: Dict[str, int]              # pub hex -> id
+    verify_signatures: bool = True
+
+    reverse_participants: Dict[int, str] = field(init=False)
+    events: List[Event] = field(default_factory=list)          # by slot
+    slot_of: Dict[str, int] = field(default_factory=dict)      # hex -> slot
+    levels: List[int] = field(default_factory=list)            # by slot
+    sp_slot: List[int] = field(default_factory=list)
+    op_slot: List[int] = field(default_factory=list)
+    chains: List[List[int]] = field(init=False)                # creator -> slots
+    pending: List[int] = field(default_factory=list)           # unflushed slots
+
+    def __post_init__(self):
+        self.reverse_participants = {v: k for k, v in self.participants.items()}
+        self.chains = [[] for _ in range(len(self.participants))]
+
+    @property
+    def n(self) -> int:
+        return len(self.participants)
+
+    @property
+    def n_events(self) -> int:
+        return len(self.events)
+
+    # ------------------------------------------------------------------
+
+    def insert(self, event: Event) -> int:
+        """Validate and index one event; returns its slot."""
+        creator = event.creator
+        cid = self.participants.get(creator)
+        if cid is None:
+            raise InsertError(f"unknown participant {creator[:18]}…")
+        if self.verify_signatures and not event.verify():
+            raise InsertError("invalid signature")
+
+        sp, op = event.self_parent, event.other_parent
+        chain = self.chains[cid]
+        if sp == "" and op == "" and not chain:
+            if event.index != 0:
+                raise InsertError(
+                    f"root event must have index 0, got {event.index}"
+                )
+            sps = ops = -1
+        else:
+            sps = self.slot_of.get(sp, -1)
+            if sps < 0:
+                raise InsertError(
+                    f"self-parent not known (creator already has "
+                    f"{len(chain)} events — possible fork)"
+                    if sp == ""
+                    else f"self-parent not known ({sp[:18]}…)"
+                )
+            if self.events[sps].creator != creator:
+                raise InsertError("self-parent has different creator")
+            ops = self.slot_of.get(op, -1)
+            if ops < 0:
+                # non-root events need both parents (reference requires the
+                # other-parent lookup to succeed, hashgraph.go:381-384)
+                raise InsertError(f"other-parent not known ({op[:18]}…)")
+            if not chain or chain[-1] != sps:
+                raise InsertError("self-parent not last known event by creator")
+            if event.index != len(chain):
+                raise InsertError(
+                    f"bad sequence index {event.index}, expected {len(chain)}"
+                )
+
+        hex_id = event.hex()
+        if hex_id in self.slot_of:
+            raise InsertError("duplicate event")
+
+        slot = len(self.events)
+        event.topological_index = slot
+        level = 0
+        if sps >= 0 or ops >= 0:
+            level = 1 + max(
+                self.levels[sps] if sps >= 0 else -1,
+                self.levels[ops] if ops >= 0 else -1,
+            )
+        self.events.append(event)
+        self.slot_of[hex_id] = slot
+        self.levels.append(level)
+        self.sp_slot.append(sps)
+        self.op_slot.append(ops)
+        chain.append(slot)
+        self.pending.append(slot)
+        return slot
+
+    # ------------------------------------------------------------------
+
+    def take_pending(self) -> Tuple[np.ndarray, ...]:
+        """Drain pending slots into batch arrays + a level-grouped schedule.
+
+        Returns (sp, op, creator, seq, ts, mbit, sched) as numpy arrays;
+        sched holds batch positions (0-based within this batch), -1 padding.
+        """
+        slots = self.pending
+        self.pending = []
+        k = len(slots)
+        sp = np.empty(k, np.int32)
+        op = np.empty(k, np.int32)
+        creator = np.empty(k, np.int32)
+        seq = np.empty(k, np.int32)
+        ts = np.empty(k, np.int64)
+        mbit = np.empty(k, bool)
+        lev = np.empty(k, np.int64)
+        for i, s in enumerate(slots):
+            ev = self.events[s]
+            sp[i] = self.sp_slot[s]
+            op[i] = self.op_slot[s]
+            creator[i] = self.participants[ev.creator]
+            seq[i] = ev.index
+            ts[i] = ev.body.timestamp
+            mbit[i] = ev.middle_bit()
+            lev[i] = self.levels[s]
+
+        # group batch positions by level
+        order = np.argsort(lev, kind="stable")
+        ulev, starts = np.unique(lev[order], return_index=True)
+        bounds = list(starts) + [k]
+        t = len(ulev)
+        b = max(int(np.max(np.diff(bounds))), 1) if t else 1
+        sched = np.full((max(t, 1), b), -1, np.int32)
+        for row in range(t):
+            grp = order[bounds[row] : bounds[row + 1]]
+            sched[row, : len(grp)] = grp
+        return sp, op, creator, seq, ts, mbit, sched
+
+    # ------------------------------------------------------------------
+    # wire conversion (reference hashgraph.go:496-571)
+
+    def to_wire(self, event: Event) -> WireEvent:
+        sp = event.self_parent
+        op = event.other_parent
+        sp_index = self.events[self.slot_of[sp]].index if sp else -1
+        if op:
+            op_ev = self.events[self.slot_of[op]]
+            op_creator_id = self.participants[op_ev.creator]
+            op_index = op_ev.index
+        else:
+            op_creator_id = op_index = -1
+        return event.to_wire(
+            sp_index, op_creator_id, op_index, self.participants[event.creator]
+        )
+
+    def read_wire_info(self, wevent: WireEvent) -> Event:
+        creator = self.reverse_participants[wevent.creator_id]
+        cid = wevent.creator_id
+        self_parent = ""
+        other_parent = ""
+        if wevent.self_parent_index >= 0:
+            self_parent = self.events[
+                self.chains[cid][wevent.self_parent_index]
+            ].hex()
+        if wevent.other_parent_index >= 0:
+            other_parent = self.events[
+                self.chains[wevent.other_parent_creator_id][wevent.other_parent_index]
+            ].hex()
+        body = EventBody(
+            transactions=list(wevent.transactions),
+            self_parent=self_parent,
+            other_parent=other_parent,
+            creator=pub_hex_to_bytes(creator),
+            timestamp=wevent.timestamp,
+            index=wevent.index,
+        )
+        return Event(body=body, r=wevent.r, s=wevent.s)
+
+    def participant_events(self, creator: str, skip: int) -> List[str]:
+        """Event hexes of `creator` with seq >= skip (the gossip diff unit,
+        reference node/core.go:108-132)."""
+        cid = self.participants[creator]
+        return [self.events[s].hex() for s in self.chains[cid][skip:]]
+
+    def known(self) -> Dict[int, int]:
+        return {cid: len(chain) for cid, chain in enumerate(self.chains)}
+
+    def last_from(self, creator: str) -> str:
+        chain = self.chains[self.participants[creator]]
+        return self.events[chain[-1]].hex() if chain else ""

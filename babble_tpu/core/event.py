@@ -125,6 +125,11 @@ class Event:
 
     # --- crypto -----------------------------------------------------------
 
+    def clone(self) -> "Event":
+        """Fresh Event sharing the immutable body/signature but with its own
+        engine-assigned consensus fields (round_received, timestamps)."""
+        return Event(body=self.body, r=self.r, s=self.s)
+
     def sign(self, key: ck.KeyPair) -> None:
         self.r, self.s = key.sign_digest(self.body.digest())
         self._hash = None

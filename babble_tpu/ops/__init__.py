@@ -1,0 +1,22 @@
+"""Jitted JAX kernels over the dense DAG state.
+
+This package is where babble's consensus math becomes TPU programs
+(SURVEY.md §7, BASELINE.json north star):
+
+- ``state``   — the struct-of-arrays DagState pytree in device memory
+- ``ingest``  — event ingestion: coordinate-vector fill (level scan),
+                first-descendant maintenance, round assignment
+- ``fame``    — virtual voting as a diagonal vote scan with batched
+                (R, N, N) matmuls on the MXU
+- ``order``   — round-received + median consensus timestamps
+
+NOTE: importing this package enables jax x64 globally.  Consensus timestamps
+are int64 nanoseconds and must survive device-side median computation
+bit-exactly; every other array in the engine pins an explicit 32-bit dtype,
+so the hot kernels are unaffected.  Import ``babble_tpu.consensus.oracle``
+(pure Python) if you need the semantics without touching jax state.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)

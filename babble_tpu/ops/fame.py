@@ -1,0 +1,139 @@
+"""DecideFame: virtual voting as a diagonal vote scan.
+
+The reference's hottest loop (hashgraph.go:598-664) is a quadruple loop —
+rounds i x voting rounds j x witnesses x x witnesses y — with a per-pair
+StronglySee.  Lifted to TPU:
+
+- Witness tensors are creator-indexed: ``law/fdw[R, N, N]`` gather the
+  coordinate rows of every round's witnesses once.
+- ``ss_next[r, a, b]`` (does round-(r+1) witness a strongly see round-r
+  witness b) and ``see_next[r, a, x]`` (direct votes at distance 1) are
+  precomputed as fused compare-count reductions.
+- The vote recursion runs over the *diagonal* d = j - i: at step d every
+  undecided round i is voted on by round i+d simultaneously.  The tally
+      yays[i, y, x] = sum_w ss[i+d-1, y, w] * votes[i, w, x]
+  is a batched (R, N, N) @ (R, N, N) matmul in f32 — MXU work; counts stay
+  exact (N < 2^24).
+- Normal rounds (d % N != 0) decide at a supermajority tally; coin rounds
+  flip undecided votes on the middle bit of the voter's hash
+  (hashgraph.go:643-649).
+
+Decisions are sticky (see oracle.py divergence note 1): all deciding voters
+provably agree within a round (two supermajorities of the same witness set
+overlap), so decision order is immaterial.
+
+After voting, the last-consensus-round advances to the highest round in the
+window whose witnesses are all decided (hashgraph.go:654-673).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .state import (
+    FAME_FALSE,
+    FAME_TRUE,
+    FAME_UNDEFINED,
+    DagConfig,
+    DagState,
+    I32,
+    sanitize,
+)
+
+F32 = jnp.float32
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def decide_fame(cfg: DagConfig, state: DagState) -> DagState:
+    n, r_cap, sm = cfg.n, cfg.r_cap, cfg.super_majority
+    R = r_cap
+
+    wsl = state.wslot[:R]                              # i32[R, N]
+    valid_w = wsl >= 0
+    ws = sanitize(wsl, cfg.e_cap)
+    law = state.la[ws]                                 # i32[R, N, N]
+    fdw = state.fd[ws]                                 # i32[R, N, N]
+    seqw = state.seq[ws]                               # i32[R, N]
+    mbw = state.mbit[ws]                               # bool[R, N]
+
+    # law rows of the *next* round, aligned to index r (sentinel -1 rows past end)
+    law_next = jnp.concatenate([law[1:], jnp.full((1, n, n), -1, I32)], axis=0)
+    valid_next = jnp.concatenate([valid_w[1:], jnp.zeros((1, n), bool)], axis=0)
+
+    # ss_next[r, a, b]: witness a of round r+1 strongly sees witness b of round r
+    ss_cnt = (law_next[:, :, None, :] >= fdw[:, None, :, :]).sum(-1)   # [R, N, N]
+    ss_next = (
+        (ss_cnt >= sm) & valid_next[:, :, None] & valid_w[:, None, :]
+    ).astype(F32)
+    tot_next = ss_next.sum(-1)                         # f32[R, N]
+
+    # see_next[r, a, x]: witness a of round r+1 sees witness x of round r
+    see_next = (
+        (law_next >= seqw[:, None, :])
+        & valid_next[:, :, None]
+        & valid_w[:, None, :]
+    ).astype(F32)
+
+    # zero-padded doubles so a dynamic_slice at offset d stays in range
+    zpad3 = jnp.zeros((R, n, n), F32)
+    ss_pad = jnp.concatenate([ss_next, zpad3], axis=0)        # [2R, N, N]
+    tot_pad = jnp.concatenate([tot_next, jnp.zeros((R, n), F32)], axis=0)
+    mb_pad = jnp.concatenate([mbw, jnp.zeros((R, n), bool)], axis=0)
+
+    i_idx = jnp.arange(R, dtype=I32)
+    in_window = (i_idx > state.lcr) & (i_idx < state.max_round)
+
+    def step(d, carry):
+        votes, famous = carry
+        # voting round j = i + d exists only while j <= max_round
+        can_vote = (i_idx + d) <= state.max_round                   # [R]
+
+        z = jnp.zeros((), I32)
+        ss_d = jax.lax.dynamic_slice(ss_pad, (d - 1, z, z), (R, n, n))
+        tot_d = jax.lax.dynamic_slice(tot_pad, (d - 1, z), (R, n))
+        mb_d = jax.lax.dynamic_slice(mb_pad, (d, z), (R, n))
+
+        yays = jnp.einsum(
+            "iyw,iwx->iyx", ss_d, votes, preferred_element_type=F32
+        )
+        nays = tot_d[:, :, None] - yays
+        v = yays >= nays
+        t = jnp.maximum(yays, nays)
+        strong = t >= sm                                            # [R, N, N]
+
+        undecided = (famous == FAME_UNDEFINED) & valid_w & in_window[:, None]
+        normal = (d % n) != 0
+
+        deciding = strong & normal & can_vote[:, None, None]
+        decide_x = deciding.any(axis=1)                             # [R, N]
+        v_star = (deciding & v).any(axis=1)                         # agree (proof in oracle)
+        famous = jnp.where(
+            undecided & decide_x,
+            jnp.where(v_star, FAME_TRUE, FAME_FALSE).astype(jnp.int8),
+            famous,
+        )
+
+        coin_vote = jnp.where(strong, v, mb_d[:, :, None])
+        new_votes = jnp.where(normal, v, coin_vote).astype(F32)
+        votes = jnp.where(can_vote[:, None, None], new_votes, votes)
+        return votes, famous
+
+    d_max = jnp.maximum(state.max_round - jnp.maximum(state.lcr, -1), 2)
+    votes0 = see_next
+    votes, famous = jax.lax.fori_loop(
+        2, d_max + 1, step, (votes0, state.famous[:R])
+    )
+
+    # advance last consensus round: highest window round with all witnesses
+    # decided (matching the reference's ascending set-on-each-decided-i loop)
+    decided_round = ((~valid_w) | (famous != FAME_UNDEFINED)).all(axis=1)
+    has_w = valid_w.any(axis=1)
+    cand = in_window & decided_round & has_w
+    new_lcr = jnp.max(jnp.where(cand, i_idx, -1))
+    lcr = jnp.maximum(state.lcr, new_lcr)
+
+    famous_out = state.famous.at[:R].set(famous)
+    return state._replace(famous=famous_out, lcr=lcr)

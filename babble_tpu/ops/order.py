@@ -1,0 +1,84 @@
+"""DecideRoundReceived + consensus timestamps, dense.
+
+Reference semantics (hashgraph.go:676-721): an undetermined event x is
+*received* in the first round i > round(x) whose witnesses are all decided
+and where more than half of the famous witnesses see x; its consensus
+timestamp is the median of the timestamps of each such witness's oldest
+self-ancestor that sees x.
+
+Dense formulation:
+- see(w, x) flips to the first-descendant form: fd[x, creator(w)] <= seq(w)
+  — row-contiguous in the event axis, so the per-round scan is a fused
+  [E, N] compare-count against the round's witness-seq row.
+- The oldest self-ancestor of witness w (creator j) to see x is creator j's
+  event at seq fd[x, j] (hashgraph.go:166-177 via the suffix property of
+  self-chains), so the median inputs are ts[ce[j, fd[x, j]]] masked to the
+  famous witnesses that see x — one gather + row sort.
+
+Undecided rounds are *skipped, not break points* (reference uses `continue`,
+hashgraph.go:684-686): a later decided round can receive an event even if an
+earlier round is still undecided.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .state import FAME_TRUE, FAME_UNDEFINED, INT32_MAX, DagConfig, DagState, I32, I64, sanitize
+
+INT64_MAX = jnp.iinfo(jnp.int64).max
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+def decide_order(cfg: DagConfig, state: DagState) -> DagState:
+    n, R, e1 = cfg.n, cfg.r_cap, cfg.e_cap + 1
+
+    wsl = state.wslot[:R]
+    valid_w = wsl >= 0
+    ws = sanitize(wsl, cfg.e_cap)
+    seqw = state.seq[ws]                                   # [R, N]
+    fam = (state.famous[:R] == FAME_TRUE) & valid_w        # [R, N]
+    decided = ((~valid_w) | (state.famous[:R] != FAME_UNDEFINED)).all(axis=1)
+    has_w = valid_w.any(axis=1)
+    fam_cnt = fam.sum(axis=1)                              # [R]
+
+    valid_e = (jnp.arange(e1) < state.n_events) & (state.seq >= 0)
+    und = valid_e & (state.rr == -1)
+
+    def step(i, rr):
+        active = decided[i] & has_w[i] & (i <= state.max_round)
+        sees = fam[i][None, :] & (state.fd <= seqw[i][None, :])      # [E+1, N]
+        c = sees.sum(axis=1)
+        cond = (
+            und
+            & (rr == -1)
+            & (i > state.round)
+            & active
+            & (c > fam_cnt[i] // 2)
+        )
+        return jnp.where(cond, i, rr)
+
+    rr = jax.lax.fori_loop(1, R, step, state.rr)
+    newly = und & (rr != -1)
+
+    # consensus timestamps for newly-received events
+    i_of = jnp.clip(rr, 0, R - 1)
+    fam_i = fam[i_of]                                      # [E+1, N]
+    seqw_i = seqw[i_of]                                    # [E+1, N]
+    sees_i = fam_i & (state.fd <= seqw_i)                  # [E+1, N]
+
+    cej = state.ce[:n]                                     # [N, S+1]
+    slot_t = cej[
+        jnp.arange(n)[None, :], jnp.clip(state.fd, 0, cfg.s_cap)
+    ]                                                      # [E+1, N]
+    tv = state.ts[sanitize(slot_t, cfg.e_cap)]             # i64[E+1, N]
+    tv = jnp.where(sees_i, tv, INT64_MAX)
+    tv_sorted = jnp.sort(tv, axis=1)
+    cnt_s = sees_i.sum(axis=1)
+    med = tv_sorted[jnp.arange(e1), jnp.clip(cnt_s // 2, 0, n - 1)]
+
+    cts = jnp.where(newly, med, state.cts)
+    return state._replace(rr=rr, cts=cts)

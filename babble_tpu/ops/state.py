@@ -1,0 +1,146 @@
+"""Dense device-side DAG state: the struct-of-arrays hashgraph.
+
+The reference keeps one Go struct per event with per-participant coordinate
+slices (hashgraph/event.go:73-88) chased hash-by-hash through an LRU store.
+Here the whole DAG lives in HBM as int32 tensors indexed by *slot* (insertion
+order on this replica):
+
+- ``la[E+1, N]``  last-ancestor seq per participant   (-1 = none)
+- ``fd[E+1, N]``  first-descendant seq per participant (INT32_MAX = none)
+
+Row ``E`` (the capacity row) is a sentinel: gathering a missing parent
+(slot -1 is remapped to E) yields neutral values, which keeps every kernel
+branch-free.  All consensus predicates are elementwise/reduction ops over
+these two tensors (SURVEY.md §7 "key insight"):
+
+    ancestor(x, y)      = la[x, creator[y]] >= seq[y]
+    strongly_see(x, y)  = sum_k(la[x, k] >= fd[y, k]) >= 2N/3+1
+    see(w, x)           = fd[x, creator[w]] <= seq[w]
+
+Witness bookkeeping is creator-indexed: ``wslot[R+1, N]`` holds the slot of
+creator j's witness in round r (honest DAGs have at most one; fork-aware
+branches are a planned extension, SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+I64 = jnp.int64
+INT32_MAX = np.int32(np.iinfo(np.int32).max)
+
+# famous trilean encoding (reference roundInfo.go:24-30)
+FAME_UNDEFINED = 0
+FAME_TRUE = 1
+FAME_FALSE = 2
+
+
+class DagConfig(NamedTuple):
+    """Static shape/threshold configuration (hashable; closed over by jit)."""
+
+    n: int          # participants
+    e_cap: int      # event slot capacity
+    s_cap: int      # per-creator sequence capacity
+    r_cap: int      # round capacity
+
+    @property
+    def super_majority(self) -> int:
+        return 2 * self.n // 3 + 1
+
+
+class DagState(NamedTuple):
+    """Device arrays.  Every per-event array has e_cap+1 rows; every
+    per-round array has r_cap+1 rows; ce has an (n+1)-th dump row — the last
+    row/col of each is the write-dump & gather-sentinel for padding."""
+
+    # per-event
+    sp: jnp.ndarray        # i32[E+1]   self-parent slot, -1 = none
+    op: jnp.ndarray        # i32[E+1]   other-parent slot, -1 = none
+    creator: jnp.ndarray   # i32[E+1]
+    seq: jnp.ndarray       # i32[E+1]   index within creator chain; sentinel -1
+    ts: jnp.ndarray        # i64[E+1]   claimed timestamp (ns)
+    mbit: jnp.ndarray      # bool[E+1]  middle bit of identity hash (coin rounds)
+    la: jnp.ndarray        # i32[E+1, N]
+    fd: jnp.ndarray        # i32[E+1, N]
+    round: jnp.ndarray     # i32[E+1]   sentinel/undefined -1
+    witness: jnp.ndarray   # bool[E+1]
+    rr: jnp.ndarray        # i32[E+1]   round received, -1 undecided
+    cts: jnp.ndarray       # i64[E+1]   consensus timestamp
+
+    # per-creator
+    ce: jnp.ndarray        # i32[N+1, S+1]  (creator, seq) -> slot, -1
+    cnt: jnp.ndarray       # i32[N+1]       events per creator (Known vector)
+
+    # per-round (creator-indexed witnesses)
+    wslot: jnp.ndarray     # i32[R+1, N]    witness slot, -1 = none
+    famous: jnp.ndarray    # i8[R+1, N]     trilean
+
+    # scalars
+    n_events: jnp.ndarray  # i32
+    max_round: jnp.ndarray # i32  highest assigned round, -1 if none
+    lcr: jnp.ndarray       # i32  last consensus round, -1 if none
+
+
+def init_state(cfg: DagConfig) -> DagState:
+    e1, n, s1, r1 = cfg.e_cap + 1, cfg.n, cfg.s_cap + 1, cfg.r_cap + 1
+    return DagState(
+        sp=jnp.full((e1,), -1, I32),
+        op=jnp.full((e1,), -1, I32),
+        creator=jnp.full((e1,), n, I32),       # sentinel creator = dump col
+        seq=jnp.full((e1,), -1, I32),
+        ts=jnp.zeros((e1,), I64),
+        mbit=jnp.zeros((e1,), jnp.bool_),
+        la=jnp.full((e1, n), -1, I32),
+        fd=jnp.full((e1, n), INT32_MAX, I32),
+        round=jnp.full((e1,), -1, I32),
+        witness=jnp.zeros((e1,), jnp.bool_),
+        rr=jnp.full((e1,), -1, I32),
+        cts=jnp.zeros((e1,), I64),
+        ce=jnp.full((n + 1, s1), -1, I32),
+        cnt=jnp.zeros((n + 1,), I32),
+        wslot=jnp.full((r1, n), -1, I32),
+        famous=jnp.zeros((r1, n), jnp.int8),
+        n_events=jnp.zeros((), I32),
+        max_round=jnp.full((), -1, I32),
+        lcr=jnp.full((), -1, I32),
+    )
+
+
+def grow_state(state: DagState, old: DagConfig, new: DagConfig) -> DagState:
+    """Copy arrays into larger-capacity buffers (sentinel rows preserved at
+    the new last index).  Host-side, called rarely; triggers re-jit."""
+    fresh = init_state(new)
+
+    def copy_events(dst, src):
+        return dst.at[: old.e_cap].set(src[: old.e_cap])
+
+    return fresh._replace(
+        sp=copy_events(fresh.sp, state.sp),
+        op=copy_events(fresh.op, state.op),
+        creator=copy_events(fresh.creator, state.creator),
+        seq=copy_events(fresh.seq, state.seq),
+        ts=copy_events(fresh.ts, state.ts),
+        mbit=copy_events(fresh.mbit, state.mbit),
+        la=fresh.la.at[: old.e_cap].set(state.la[: old.e_cap]),
+        fd=fresh.fd.at[: old.e_cap].set(state.fd[: old.e_cap]),
+        round=copy_events(fresh.round, state.round),
+        witness=copy_events(fresh.witness, state.witness),
+        rr=copy_events(fresh.rr, state.rr),
+        cts=copy_events(fresh.cts, state.cts),
+        ce=fresh.ce.at[: old.n + 1, : old.s_cap].set(state.ce[:, : old.s_cap]),
+        cnt=fresh.cnt.at[: old.n + 1].set(state.cnt),
+        wslot=fresh.wslot.at[: old.r_cap].set(state.wslot[: old.r_cap]),
+        famous=fresh.famous.at[: old.r_cap].set(state.famous[: old.r_cap]),
+        n_events=state.n_events,
+        max_round=state.max_round,
+        lcr=state.lcr,
+    )
+
+
+def sanitize(idx: jnp.ndarray, sentinel: int) -> jnp.ndarray:
+    """Remap negative (missing) indices to the sentinel row."""
+    return jnp.where(idx < 0, sentinel, idx)

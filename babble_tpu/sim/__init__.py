@@ -1,0 +1,10 @@
+"""Synthetic DAG generation and batch consensus simulation.
+
+The north-star benchmark path (BASELINE.json): generate realistic gossip
+DAGs at scale (uniform arrival; byzantine-fork variants planned), push them
+through the TPU engine in batch, and measure events/sec to consensus order.
+"""
+
+from .generator import GeneratedDag, random_gossip_dag
+
+__all__ = ["GeneratedDag", "random_gossip_dag"]
