@@ -104,6 +104,41 @@ class TpuHashgraph:
         """Push pending host events through the device ingest pipeline."""
         if not self.dag.pending:
             return
+        batch, fd_mode = self.build_batch()
+        self.state = ingest_ops.ingest(self.cfg, self.state, fd_mode, batch)
+        self._view = {}
+        # Round-capacity saturation check: if the highest assigned round is
+        # at the capacity edge, witness-table writes may have clipped and
+        # round assignment stalled — grow and recompute from host truth.
+        if int(self.state.max_round) >= self.cfg.r_cap - 1:
+            self._rebuild(r_cap=self.cfg.r_cap * 2)
+
+    def _rebuild(self, r_cap: int) -> None:
+        """Re-ingest the full host DAG into a fresh state with a larger
+        round capacity.  Fame/order decisions are recomputed on the next
+        pipeline call — they are deterministic, and `_received` keeps
+        already-committed events from being emitted twice."""
+        while r_cap <= int(self.state.max_round) + 1:
+            r_cap *= 2
+        self.cfg = DagConfig(
+            n=self.cfg.n, e_cap=self.cfg.e_cap, s_cap=self.cfg.s_cap,
+            r_cap=r_cap, n_real=self.cfg.n_real,
+        )
+        self.state = init_state(self.cfg)
+        self.dag.pending = list(range(self.dag.n_events))
+        batch, _ = self.build_batch()
+        self.state = ingest_ops.ingest(self.cfg, self.state, "full", batch)
+        self._view = {}
+        if int(self.state.max_round) >= self.cfg.r_cap - 1:  # still clipped
+            self._rebuild(r_cap=self.cfg.r_cap * 2)
+
+    def build_batch(self):
+        """Drain pending host events into a padded device EventBatch.
+
+        Returns (batch, fd_mode).  Normally consumed by flush(); exposed so
+        alternative executors (the sharded pipeline, the graft entry) can
+        feed the same batches through their own jitted step.
+        """
         k = len(self.dag.pending)
         self._ensure_capacity(k)
         sp, op, creator, seq, ts, mbit, sched = self.dag.take_pending()
@@ -131,16 +166,22 @@ class TpuHashgraph:
             sched=jnp.asarray(sched_p),
         )
         fd_mode = "full" if k > _FD_FULL_THRESHOLD else "incremental"
-        self.state = ingest_ops.ingest(self.cfg, self.state, fd_mode, batch)
-        self._view = {}
+        return batch, fd_mode
 
     def _ensure_capacity(self, k_new: int) -> None:
         cfg = self.cfg
         need_e = self.dag.n_events  # host already includes pending
         max_chain = max((len(c) for c in self.dag.chains), default=0)
-        # each new topological level can raise the max round by at most 1
+        # Rounds heuristic: a level can raise the max round by at most 1,
+        # but in practice a round spans several levels, so sizing r_cap by
+        # level count would inflate the fame/order tensors ~4x.  Undershoot
+        # is safe: flush() detects wslot saturation and rebuilds.
         levels_new = len({self.dag.levels[s] for s in self.dag.pending})
-        need_r = max(int(self.state.max_round), 0) + levels_new + 2
+        need_r = (
+            max(int(self.state.max_round), 0)
+            + 2
+            + min(levels_new, max(8, levels_new // 4))
+        )
 
         e_cap, s_cap, r_cap = cfg.e_cap, cfg.s_cap, cfg.r_cap
         while need_e > e_cap:
@@ -150,7 +191,10 @@ class TpuHashgraph:
         while need_r >= r_cap:
             r_cap *= 2
         if (e_cap, s_cap, r_cap) != (cfg.e_cap, cfg.s_cap, cfg.r_cap):
-            new_cfg = DagConfig(n=cfg.n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap)
+            new_cfg = DagConfig(
+                n=cfg.n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap,
+                n_real=cfg.n_real,
+            )
             self.state = grow_state(self.state, cfg, new_cfg)
             self.cfg = new_cfg
             self._view = {}

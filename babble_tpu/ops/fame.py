@@ -46,8 +46,9 @@ from .state import (
 F32 = jnp.float32
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
-def decide_fame(cfg: DagConfig, state: DagState) -> DagState:
+def decide_fame_impl(cfg: DagConfig, state: DagState) -> DagState:
+    """Unjitted body — composable under an outer jit (graft entry, sharded
+    pipeline).  Use ``decide_fame`` for the standalone jitted form."""
     n, r_cap, sm = cfg.n, cfg.r_cap, cfg.super_majority
     R = r_cap
 
@@ -88,6 +89,7 @@ def decide_fame(cfg: DagConfig, state: DagState) -> DagState:
 
     def step(d, carry):
         votes, famous = carry
+        d = jnp.asarray(d, I32)  # fori_loop counter is i64 under x64
         # voting round j = i + d exists only while j <= max_round
         can_vote = (i_idx + d) <= state.max_round                   # [R]
 
@@ -105,7 +107,8 @@ def decide_fame(cfg: DagConfig, state: DagState) -> DagState:
         strong = t >= sm                                            # [R, N, N]
 
         undecided = (famous == FAME_UNDEFINED) & valid_w & in_window[:, None]
-        normal = (d % n) != 0
+        # coin-round period = number of real participants (hashgraph.go:643)
+        normal = (d % cfg.active_n) != 0
 
         deciding = strong & normal & can_vote[:, None, None]
         decide_x = deciding.any(axis=1)                             # [R, N]
@@ -137,3 +140,6 @@ def decide_fame(cfg: DagConfig, state: DagState) -> DagState:
 
     famous_out = state.famous.at[:R].set(famous)
     return state._replace(famous=famous_out, lcr=lcr)
+
+
+decide_fame = jax.jit(decide_fame_impl, static_argnums=(0,), donate_argnums=(1,))

@@ -232,8 +232,7 @@ def _rounds_level_scan(
     return state._replace(round=rnd, witness=wit, wslot=wslot, max_round=max_round)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2), donate_argnums=(1,))
-def ingest(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch) -> DagState:
+def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch) -> DagState:
     """Ingest a topologically-ordered batch of events end to end.
 
     fd_mode: 'incremental' (O(K·E), live gossip path) or 'full'
@@ -249,3 +248,6 @@ def ingest(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch) -> 
         state = _fd_full(state, cfg)
     state = _rounds_level_scan(state, cfg, slot_sched, batch.sched)
     return _reset_event_sentinels(state, cfg)
+
+
+ingest = jax.jit(ingest_impl, static_argnums=(0, 2), donate_argnums=(1,))

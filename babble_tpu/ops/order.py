@@ -32,8 +32,8 @@ from .state import FAME_TRUE, FAME_UNDEFINED, INT32_MAX, DagConfig, DagState, I3
 INT64_MAX = jnp.iinfo(jnp.int64).max
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
-def decide_order(cfg: DagConfig, state: DagState) -> DagState:
+def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
+    """Unjitted body — composable under an outer jit; see fame.decide_fame_impl."""
     n, R, e1 = cfg.n, cfg.r_cap, cfg.e_cap + 1
 
     wsl = state.wslot[:R]
@@ -82,3 +82,6 @@ def decide_order(cfg: DagConfig, state: DagState) -> DagState:
 
     cts = jnp.where(newly, med, state.cts)
     return state._replace(rr=rr, cts=cts)
+
+
+decide_order = jax.jit(decide_order_impl, static_argnums=(0,), donate_argnums=(1,))

@@ -40,16 +40,28 @@ FAME_FALSE = 2
 
 
 class DagConfig(NamedTuple):
-    """Static shape/threshold configuration (hashable; closed over by jit)."""
+    """Static shape/threshold configuration (hashable; closed over by jit).
 
-    n: int          # participants
+    ``n`` is the *array width* of the participant axis; when sharding pads
+    that axis to the mesh (parallel/sharded.py), ``n_real`` holds the true
+    participant count and thresholds (supermajority, coin-round period) use
+    it.  Padded columns hold sentinel coordinates (la=-1, fd=INT32_MAX) so
+    they never contribute to any see/vote count.  n_real=0 means n is real.
+    """
+
+    n: int          # participants (array width, possibly mesh-padded)
     e_cap: int      # event slot capacity
     s_cap: int      # per-creator sequence capacity
     r_cap: int      # round capacity
+    n_real: int = 0
+
+    @property
+    def active_n(self) -> int:
+        return self.n_real or self.n
 
     @property
     def super_majority(self) -> int:
-        return 2 * self.n // 3 + 1
+        return 2 * self.active_n // 3 + 1
 
 
 class DagState(NamedTuple):

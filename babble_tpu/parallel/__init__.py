@@ -1,0 +1,34 @@
+"""Multi-chip parallelism: device meshes, sharding specs, sharded pipeline.
+
+The reference scales by running N independent Go processes that gossip over
+TCP (node/node.go, net/) — replicated-state-machine parallelism.  On TPU the
+batch/simulation path instead shards ONE consensus computation across chips
+(SURVEY.md §2.6): the event axis ("ev", the DAG's unbounded long-context
+axis) and the participant axis ("p", the witness/vote axis) are laid out
+over a 2D ``jax.sharding.Mesh``, shardings are annotated on the DagState
+pytree, and XLA inserts the ICI collectives (all-gathers of witness rows,
+psum-style vote reductions) that replace babble's vote-counting loops.
+"""
+
+from .mesh import make_mesh
+from .sharded import (
+    batch_shardings,
+    consensus_step_impl,
+    make_sharded_step,
+    pad_cfg_for_mesh,
+    place_state,
+    sharded_init_state,
+    state_shardings,
+    state_specs,
+)
+
+__all__ = [
+    "make_mesh",
+    "state_specs",
+    "state_shardings",
+    "batch_shardings",
+    "place_state",
+    "consensus_step_impl",
+    "make_sharded_step",
+    "pad_cfg_for_mesh",
+]

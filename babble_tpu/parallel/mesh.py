@@ -1,0 +1,53 @@
+"""Device mesh construction.
+
+One 2D mesh covers both parallel axes of the consensus computation:
+
+- ``ev``  — event-axis sharding (sequence-parallel analogue; the DAG's
+  long axis, up to 1M events per BASELINE.md).
+- ``p``   — participant-axis sharding (tensor-parallel analogue; witness
+  coordinate rows and vote matrices split by creator column).
+
+On a real slice the mesh should be laid out so ``p`` rides the faster ICI
+links (witness all-gathers are the chatty collective); ``jax.devices()``
+order already reflects the physical torus for TPU backends.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def _factor(n: int) -> Tuple[int, int]:
+    """Split n into (ev, p) with p the largest power-of-two factor <= sqrt(n)."""
+    p = 1
+    while n % (p * 2) == 0 and (p * 2) ** 2 <= n:
+        p *= 2
+    return n // p, p
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the ("ev", "p") mesh over the first n_devices jax devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(devices)} available"
+        )
+    if shape is None:
+        shape = _factor(n_devices)
+    ev, p = shape
+    if ev * p != n_devices:
+        raise ValueError(f"mesh shape {shape} != device count {n_devices}")
+    grid = np.asarray(devices[:n_devices]).reshape(ev, p)
+    return Mesh(grid, ("ev", "p"))

@@ -1,0 +1,116 @@
+"""Sharding layout + the sharded end-to-end consensus step.
+
+Layout (annotate-and-let-XLA-partition, the pjit recipe):
+
+- per-event vectors (sp, op, creator, seq, ts, mbit, round, witness, rr,
+  cts): split along the event axis → ``P("ev")``.
+- coordinate matrices la/fd ``[E+1, N]``: event rows over "ev", participant
+  columns over "p" → ``P("ev", "p")``.  StronglySee's compare-count
+  reduction then runs as per-shard partial counts + an ICI psum over "p"
+  (inserted by XLA from the sharding constraints).
+- witness tables wslot/famous ``[R+1, N]``: rounds replicated, creator
+  columns over "p" → ``P(None, "p")`` (every round is touched by the fame
+  scan each step; the N axis is where the width is at 10k participants).
+- creator tables ce/cnt (+1-row sentinel shapes, small: ~N·S int32) and
+  scalars + ingest batches: replicated.
+
+Explicit shardings must divide the array dims, so ``pad_cfg_for_mesh``
+rounds the event capacity up to a multiple of the "ev" axis (keeping the
++1 sentinel row) and pads the participant width to a multiple of "p" with
+dead columns — sentinel coordinates (la=-1, fd=INT32_MAX) make padded
+participants invisible to every see/vote count, and DagConfig.n_real keeps
+the supermajority + coin-round thresholds on the true count.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.fame import decide_fame_impl
+from ..ops.ingest import EventBatch, ingest_impl
+from ..ops.order import decide_order_impl
+from ..ops.state import DagConfig, DagState, init_state
+
+
+def state_specs() -> DagState:
+    """DagState-shaped pytree of PartitionSpecs."""
+    ev = P("ev")
+    return DagState(
+        sp=ev, op=ev, creator=ev, seq=ev, ts=ev, mbit=ev,
+        la=P("ev", "p"), fd=P("ev", "p"),
+        round=ev, witness=ev, rr=ev, cts=ev,
+        ce=P(), cnt=P(),
+        wslot=P(None, "p"), famous=P(None, "p"),
+        n_events=P(), max_round=P(), lcr=P(),
+    )
+
+
+def state_shardings(mesh: Mesh) -> DagState:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), state_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_shardings(mesh: Mesh) -> EventBatch:
+    """Ingest batches are small relative to state: replicate them."""
+    rep = NamedSharding(mesh, P())
+    return EventBatch(
+        sp=rep, op=rep, creator=rep, seq=rep, ts=rep, mbit=rep, k=rep,
+        sched=rep,
+    )
+
+
+def place_state(state: DagState, mesh: Mesh) -> DagState:
+    return jax.device_put(state, state_shardings(mesh))
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def pad_cfg_for_mesh(cfg: DagConfig, mesh: Mesh) -> DagConfig:
+    """Round capacities up so every sharded dim divides its mesh axis."""
+    ev = mesh.shape["ev"]
+    p = mesh.shape["p"]
+    n_pad = _ceil_to(cfg.n, p)
+    e_cap = _ceil_to(cfg.e_cap + 1, ev) - 1
+    n_real = cfg.n_real or cfg.n
+    return DagConfig(
+        n=n_pad, e_cap=e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
+        n_real=n_real,
+    )
+
+
+def consensus_step_impl(
+    cfg: DagConfig, fd_mode: str, state: DagState, batch: EventBatch
+) -> DagState:
+    """The full step: ingest a gossip batch, then run the whole consensus
+    pipeline (DivideRounds ≡ ingest's round scan, DecideFame, FindOrder's
+    device half).  This is the framework's 'training step' — the unit the
+    multichip dry-run jits over a mesh."""
+    state = ingest_impl(cfg, state, fd_mode, batch)
+    state = decide_fame_impl(cfg, state)
+    state = decide_order_impl(cfg, state)
+    return state
+
+
+def make_sharded_step(cfg: DagConfig, mesh: Mesh, fd_mode: str = "full"):
+    """Jit the full consensus step with mesh shardings annotated in/out."""
+    ss = state_shardings(mesh)
+    return jax.jit(
+        functools.partial(consensus_step_impl, cfg, fd_mode),
+        in_shardings=(ss, batch_shardings(mesh)),
+        out_shardings=ss,
+        donate_argnums=(0,),
+    )
+
+
+def sharded_init_state(cfg: DagConfig, mesh: Mesh) -> DagState:
+    return place_state(init_state(cfg), mesh)

@@ -1,0 +1,94 @@
+"""Headline benchmark: consensus events/sec to full order on one chip.
+
+Workload: a 64-participant / 16384-event random-gossip DAG (the same shape
+babble's TestGossip produces live) pushed through the whole device pipeline
+— coordinate ingest, round division, fame voting, order + timestamps — as
+one jitted step.  Reported value is events brought to consensus order per
+second of device wall time (median of repeats, post-compile).
+
+Baseline: the reference's only published figure, 264.65 consensus events/s
+on its 4-node Docker testnet (reference README.md:154; see BASELINE.md).
+
+Prints exactly one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+BASELINE_EVENTS_PER_SEC = 264.65
+
+N = 64
+E = 16384
+R_CAP = 256
+REPEATS = 3
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    from babble_tpu.consensus.engine import TpuHashgraph
+    from babble_tpu.ops.state import init_state
+    from babble_tpu.parallel.sharded import consensus_step_impl
+    from babble_tpu.sim.generator import random_gossip_dag
+
+    import jax
+    import numpy as np
+
+    log(f"devices: {jax.devices()}")
+    t0 = time.perf_counter()
+    dag = random_gossip_dag(N, E, seed=7)
+    log(f"generated {E} events over {N} participants "
+        f"in {time.perf_counter()-t0:.1f}s")
+
+    eng = TpuHashgraph(
+        dag.participants, verify_signatures=False,
+        e_cap=E, s_cap=1024, r_cap=R_CAP,
+    )
+    t0 = time.perf_counter()
+    for ev in dag.events:
+        eng.insert_event(ev)
+    batch, _ = eng.build_batch()
+    cfg = eng.cfg  # build_batch may have grown capacities
+    log(f"host index + batch build: {time.perf_counter()-t0:.1f}s; cfg {cfg}")
+
+    step = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))
+
+    t0 = time.perf_counter()
+    out = step(init_state(cfg), batch)
+    jax.block_until_ready(out)
+    log(f"compile + first run: {time.perf_counter()-t0:.1f}s")
+    ordered = int(np.count_nonzero(np.asarray(out.rr)[: E] >= 0))
+    lcr = int(out.lcr)
+    log(f"ordered {ordered}/{E} events, last consensus round {lcr}, "
+        f"max round {int(out.max_round)}")
+    assert ordered > 0, "benchmark DAG reached no consensus"
+    assert int(out.max_round) < cfg.r_cap - 1, "round capacity saturated"
+
+    times = []
+    for _ in range(REPEATS):
+        s0 = init_state(cfg)
+        jax.block_until_ready(s0)
+        t0 = time.perf_counter()
+        out = step(s0, batch)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t = sorted(times)[len(times) // 2]
+    log(f"times: {[f'{x:.3f}' for x in times]}")
+
+    events_per_sec = ordered / t
+    print(json.dumps({
+        "metric": "consensus_events_per_sec",
+        "value": round(events_per_sec, 2),
+        "unit": "events/s",
+        "vs_baseline": round(events_per_sec / BASELINE_EVENTS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,22 @@
-"""Test configuration: force a virtual 8-device CPU platform BEFORE jax import.
+"""Test configuration: force a virtual 8-device CPU platform.
 
 Multi-chip sharding tests run on a simulated 8-device CPU mesh
 (xla_force_host_platform_device_count); real-TPU execution is exercised by
 bench.py and the driver's graft entry, not the unit tests.
+
+The XLA flag must be in the environment before the CPU backend initializes;
+the platform override must go through jax.config because the environment's
+TPU plugin registration (sitecustomize) takes precedence over JAX_PLATFORMS.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
