@@ -1,0 +1,172 @@
+"""CLI (reference cmd/main.go:39-260): keygen, run, sim.
+
+- ``keygen``  — print (or write to a datadir) a PEM keypair.
+- ``run``     — boot a node: key + peers from the datadir, TCP transport,
+  socket or inmem proxy, /Stats service, then the gossip loop.
+- ``sim``     — generate a random gossip DAG and run batch consensus on
+  the device pipeline (no networking; the benchmark path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+def cmd_keygen(args) -> int:
+    from .crypto.keys import PemKeyFile, generate_key, pem_dump
+
+    key = generate_key()
+    if args.datadir:
+        pem = PemKeyFile(args.datadir)
+        if pem.exists():
+            print(f"key already exists in {args.datadir}", file=sys.stderr)
+            return 1
+        pem.write(key)
+        print(f"wrote {pem.path}")
+    priv, pub = pem_dump(key)
+    print(f"PublicKey:\n{pub}")
+    if not args.datadir:
+        print(f"PrivateKey:\n{priv}")
+    return 0
+
+
+async def _run_node(args) -> int:
+    from .crypto.keys import PemKeyFile
+    from .net.peers import JSONPeers
+    from .net.tcp_transport import new_tcp_transport
+    from .node.config import Config
+    from .node.node import Node
+    from .proxy.inmem import InmemAppProxy
+    from .proxy.socket_app import SocketAppProxy
+    from .service.service import Service
+
+    key = PemKeyFile(args.datadir).read()
+    peers = JSONPeers(args.datadir).peers()
+
+    conf = Config(
+        heartbeat=args.heartbeat / 1000.0,
+        tcp_timeout=args.tcp_timeout / 1000.0,
+        cache_size=args.cache_size,
+    )
+    conf.logger.setLevel(args.log_level.upper())
+
+    transport = await new_tcp_transport(
+        args.node_addr, max_pool=args.max_pool,
+        timeout=conf.tcp_timeout,
+    )
+
+    if args.no_client:
+        proxy = InmemAppProxy()
+    else:
+        proxy = SocketAppProxy(args.client_addr, args.proxy_addr,
+                               timeout=conf.tcp_timeout)
+        await proxy.start()
+
+    node = Node(conf, key, peers, transport, proxy)
+    node.init()
+    service = Service(args.service_addr, node)
+    await service.start()
+    print(f"node {node.core.id} listening on {transport.local_addr()}, "
+          f"stats on http://{service.bind_addr}/Stats")
+    try:
+        await node.run(gossip=True)
+    finally:
+        await service.close()
+        await node.shutdown()
+    return 0
+
+
+def cmd_run(args) -> int:
+    try:
+        return asyncio.run(_run_node(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_sim(args) -> int:
+    import functools
+
+    import jax
+    import numpy as np
+
+    from .consensus.engine import TpuHashgraph
+    from .parallel.sharded import consensus_step_impl
+    from .ops.state import init_state
+    from .sim.generator import random_gossip_dag
+
+    dag = random_gossip_dag(args.nodes, args.events, seed=args.seed)
+    eng = TpuHashgraph(
+        dag.participants, verify_signatures=False,
+        e_cap=args.events, s_cap=max(64, 2 * args.events // args.nodes),
+        r_cap=args.rounds,
+    )
+    for ev in dag.events:
+        eng.insert_event(ev)
+    batch, _ = eng.build_batch()
+    cfg = eng.cfg
+    step = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))
+    t0 = time.perf_counter()
+    out = step(init_state(cfg), batch)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = step(init_state(cfg), batch)
+    jax.block_until_ready(out)
+    run_s = time.perf_counter() - t0
+    ordered = int(np.count_nonzero(np.asarray(out.rr)[: args.events] >= 0))
+    print(json.dumps({
+        "nodes": args.nodes,
+        "events": args.events,
+        "ordered": ordered,
+        "last_consensus_round": int(out.lcr),
+        "max_round": int(out.max_round),
+        "compile_s": round(compile_s, 3),
+        "run_s": round(run_s, 4),
+        "events_per_sec": round(ordered / run_s, 1) if run_s > 0 else None,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="babble-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    kg = sub.add_parser("keygen", help="generate an ECDSA P-256 keypair")
+    kg.add_argument("--datadir", default="", help="write priv_key.pem here")
+    kg.set_defaults(fn=cmd_keygen)
+
+    rn = sub.add_parser("run", help="run a consensus node")
+    rn.add_argument("--datadir", default=".",
+                    help="dir with priv_key.pem and peers.json")
+    rn.add_argument("--node_addr", default="127.0.0.1:1337")
+    rn.add_argument("--no_client", action="store_true",
+                    help="use an in-memory app proxy instead of sockets")
+    rn.add_argument("--proxy_addr", default="127.0.0.1:1338",
+                    help="where we listen for the app's SubmitTx")
+    rn.add_argument("--client_addr", default="127.0.0.1:1339",
+                    help="the app's CommitTx server")
+    rn.add_argument("--service_addr", default="127.0.0.1:8000")
+    rn.add_argument("--log_level", default="info")
+    rn.add_argument("--heartbeat", type=int, default=1000, help="ms")
+    rn.add_argument("--max_pool", type=int, default=2)
+    rn.add_argument("--tcp_timeout", type=int, default=1000, help="ms")
+    rn.add_argument("--cache_size", type=int, default=500)
+    rn.set_defaults(fn=cmd_run)
+
+    sm = sub.add_parser("sim", help="batch consensus over a generated DAG")
+    sm.add_argument("--nodes", type=int, default=64)
+    sm.add_argument("--events", type=int, default=16384)
+    sm.add_argument("--rounds", type=int, default=256)
+    sm.add_argument("--seed", type=int, default=7)
+    sm.set_defaults(fn=cmd_sim)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""Communication backend: peers, the sync RPC, and pluggable transports.
+
+Mirror of the reference's ``net/`` package (net/transport.go, net/peer.go,
+net/commands.go): one RPC verb (sync), a ``Transport`` interface with TCP
+and in-memory loopback implementations, and peer bookkeeping with canonical
+id assignment by public-key sort.
+
+The wire format is msgpack frames (length-prefixed), not Go gob — only the
+information content matches the reference.
+"""
+
+from .commands import SyncRequest, SyncResponse
+from .peers import Peer, JSONPeers, StaticPeers, canonical_ids, exclude_peer
+from .transport import RPC, Transport
+from .inmem_transport import InmemTransport, InmemNetwork
+from .tcp_transport import TCPTransport
+
+__all__ = [
+    "SyncRequest",
+    "SyncResponse",
+    "Peer",
+    "JSONPeers",
+    "StaticPeers",
+    "canonical_ids",
+    "exclude_peer",
+    "RPC",
+    "Transport",
+    "InmemTransport",
+    "InmemNetwork",
+    "TCPTransport",
+]

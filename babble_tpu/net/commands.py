@@ -1,0 +1,55 @@
+"""The single RPC verb: sync (reference net/commands.go:20-29).
+
+SyncRequest carries the requester's Known map (participant id -> event
+count, the gossip vector clock); SyncResponse returns the responder's head
+plus the wire events the requester lacks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import msgpack
+
+from ..core.event import WireEvent
+
+RPC_SYNC = 0
+
+
+@dataclass
+class SyncRequest:
+    from_addr: str
+    known: Dict[int, int]
+
+    def pack(self) -> bytes:
+        return msgpack.packb(
+            [self.from_addr, sorted(self.known.items())], use_bin_type=True
+        )
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "SyncRequest":
+        from_addr, known = msgpack.unpackb(data, raw=False)
+        return cls(from_addr=from_addr, known={int(k): int(v) for k, v in known})
+
+
+@dataclass
+class SyncResponse:
+    from_addr: str
+    head: str
+    events: List[WireEvent] = field(default_factory=list)
+
+    def pack(self) -> bytes:
+        return msgpack.packb(
+            [self.from_addr, self.head, [e.pack() for e in self.events]],
+            use_bin_type=True,
+        )
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "SyncResponse":
+        from_addr, head, events = msgpack.unpackb(data, raw=False)
+        return cls(
+            from_addr=from_addr,
+            head=head,
+            events=[WireEvent.unpack(e) for e in events],
+        )

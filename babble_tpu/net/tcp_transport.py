@@ -1,0 +1,161 @@
+"""TCP transport (reference net/net_transport.go:61-395, tcp_transport.go).
+
+Framing per request: 1 type byte + u32 big-endian length + msgpack payload.
+Responses: u8 ok flag + u32 length + (error string | msgpack payload).
+Outbound connections are pooled per target (``max_pool``, reference
+net_transport.go:162-219); server side handles any number of sequential
+RPCs per connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Dict, List, Optional
+
+from ..common.aserver import AsyncTcpServer
+from .commands import RPC_SYNC, SyncRequest, SyncResponse
+from .transport import RPC, Transport, TransportError
+
+_HDR = struct.Struct(">BI")
+_RHDR = struct.Struct(">BI")
+
+
+class TCPTransport(Transport):
+    def __init__(
+        self,
+        bind_addr: str,
+        advertise: Optional[str] = None,
+        max_pool: int = 2,
+        timeout: float = 10.0,
+    ):
+        self.advertise = advertise or bind_addr
+        host = self.advertise.split(":")[0]
+        if host in ("", "0.0.0.0", "::"):
+            raise ValueError(
+                "advertise address must be a routable address, got "
+                f"{self.advertise!r} (reference tcp_transport.go:51-57)"
+            )
+        self.max_pool = max_pool
+        self.timeout = timeout
+        self._consumer: "asyncio.Queue[RPC]" = asyncio.Queue()
+        self._server = AsyncTcpServer(bind_addr, self._handle_conn)
+        self._pool: Dict[str, List[tuple]] = {}
+        self._closed = False
+
+    async def start(self) -> None:
+        requested_port = self._server.bind_addr.rsplit(":", 1)[1]
+        await self._server.start()
+        if requested_port == "0":  # resolve to the actual bound port
+            actual = self._server.bind_addr.rsplit(":", 1)[1]
+            ahost = self.advertise.rsplit(":", 1)[0]
+            self.advertise = f"{ahost}:{actual}"
+
+    @property
+    def bind_addr(self) -> str:
+        return self._server.bind_addr
+
+    @property
+    def consumer(self) -> "asyncio.Queue[RPC]":
+        return self._consumer
+
+    def local_addr(self) -> str:
+        return self.advertise
+
+    # ------------------------------------------------------------------
+    # server side
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        while not self._closed:
+            try:
+                hdr = await reader.readexactly(_HDR.size)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            rtype, ln = _HDR.unpack(hdr)
+            payload = await reader.readexactly(ln)
+            if rtype != RPC_SYNC:
+                writer.write(_RHDR.pack(1, 0) + b"")
+                await writer.drain()
+                continue
+            rpc = RPC(command=SyncRequest.unpack(payload))
+            await self._consumer.put(rpc)
+            try:
+                resp = await asyncio.wait_for(rpc.response(), self.timeout)
+                body = resp.pack()
+                writer.write(_RHDR.pack(0, len(body)) + body)
+            except Exception as e:  # handler error -> error frame
+                msg = str(e).encode()
+                writer.write(_RHDR.pack(1, len(msg)) + msg)
+            await writer.drain()
+
+    # ------------------------------------------------------------------
+    # client side
+
+    async def _get_conn(self, target: str):
+        pool = self._pool.setdefault(target, [])
+        while pool:
+            reader, writer = pool.pop()
+            if not writer.is_closing():
+                return reader, writer
+        host, port = target.rsplit(":", 1)
+        return await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), self.timeout
+        )
+
+    def _return_conn(self, target: str, conn) -> None:
+        pool = self._pool.setdefault(target, [])
+        if len(pool) < self.max_pool and not conn[1].is_closing():
+            pool.append(conn)
+        else:
+            conn[1].close()
+
+    async def sync(
+        self, target: str, req: SyncRequest, timeout: Optional[float] = None
+    ) -> SyncResponse:
+        if self._closed:
+            raise TransportError("transport closed")
+        timeout = timeout or self.timeout
+        conn = await self._get_conn(target)
+        reader, writer = conn
+        try:
+            body = req.pack()
+            writer.write(_HDR.pack(RPC_SYNC, len(body)) + body)
+            await writer.drain()
+            hdr = await asyncio.wait_for(
+                reader.readexactly(_RHDR.size), timeout
+            )
+            ok, ln = _RHDR.unpack(hdr)
+            payload = await asyncio.wait_for(reader.readexactly(ln), timeout)
+            if ok != 0:
+                raise TransportError(payload.decode(errors="replace"))
+            resp = SyncResponse.unpack(payload)
+        except BaseException as e:
+            # Any failure mid-RPC (I/O error, timeout, error frame, unpack
+            # failure, cancellation) leaves the stream in an unknown state —
+            # never pool it (reference net_transport.go:243-249).
+            writer.close()
+            if isinstance(e, (ConnectionError, OSError,
+                              asyncio.IncompleteReadError)):
+                raise TransportError(f"sync to {target} failed: {e}") from e
+            raise
+        self._return_conn(target, conn)
+        return resp
+
+    async def close(self) -> None:
+        self._closed = True
+        await self._server.close()
+        for pool in self._pool.values():
+            for _, writer in pool:
+                writer.close()
+        self._pool.clear()
+
+
+async def new_tcp_transport(
+    bind_addr: str, advertise: Optional[str] = None,
+    max_pool: int = 2, timeout: float = 10.0,
+) -> TCPTransport:
+    t = TCPTransport(bind_addr, advertise, max_pool, timeout)
+    await t.start()
+    return t

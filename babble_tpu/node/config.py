@@ -1,0 +1,32 @@
+"""Runtime configuration (reference node/config.go:26-57)."""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+
+def _default_logger() -> logging.Logger:
+    logger = logging.getLogger("babble_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s"
+        ))
+        logger.addHandler(h)
+        logger.setLevel(logging.WARNING)
+    return logger
+
+
+@dataclass
+class Config:
+    heartbeat: float = 1.0          # seconds (reference default 1000ms)
+    tcp_timeout: float = 1.0        # seconds
+    cache_size: int = 500           # engine event capacity hint
+    logger: logging.Logger = field(default_factory=_default_logger)
+
+    @classmethod
+    def test_config(cls, heartbeat: float = 0.005) -> "Config":
+        logger = logging.getLogger("babble_tpu.test")
+        logger.setLevel(logging.WARNING)
+        return cls(heartbeat=heartbeat, tcp_timeout=0.2, logger=logger)

@@ -1,0 +1,141 @@
+"""Core: one participant's consensus state (reference node/core.go:30-257).
+
+Wraps a TpuHashgraph with the node's signing key, tracks the head of the
+node's own event chain, computes gossip diffs from Known vector clocks, and
+applies incoming syncs by inserting peer events and creating a new signed
+self-event whose parents are (own head, peer head) carrying the pooled
+transactions.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..consensus.engine import TpuHashgraph
+from ..core.event import Event, WireEvent, new_event
+from ..crypto.keys import KeyPair
+
+
+class Core:
+    def __init__(
+        self,
+        core_id: int,
+        key: KeyPair,
+        participants: Dict[str, int],
+        commit_callback: Optional[Callable[[List[Event]], None]] = None,
+        engine: Optional[TpuHashgraph] = None,
+        e_cap: int = 4096,
+    ):
+        self.id = core_id
+        self.key = key
+        self.pub_hex = key.pub_hex
+        self.participants = participants
+        self.hg = engine or TpuHashgraph(
+            participants, commit_callback=commit_callback, e_cap=e_cap
+        )
+        self.head: str = ""
+        self.seq: int = -1
+
+    # ------------------------------------------------------------------
+
+    def init(self) -> None:
+        """Create + insert the node's root event (reference core.go:79-97)."""
+        ev = new_event([], ("", ""), self.key.pub_bytes, 0)
+        self.sign_and_insert_self_event(ev)
+
+    def sign_and_insert_self_event(self, event: Event) -> None:
+        event.sign(self.key)
+        self.hg.insert_event(event)
+        self.head = event.hex()
+        self.seq = event.index
+
+    def insert_event(self, event: Event) -> None:
+        self.hg.insert_event(event)
+
+    # ------------------------------------------------------------------
+    # gossip protocol
+
+    def known(self) -> Dict[int, int]:
+        return self.hg.known()
+
+    def diff(self, known: Dict[int, int]) -> List[Event]:
+        """Events we know that the peer doesn't, topologically sorted
+        (reference core.go:108-132)."""
+        out: List[Event] = []
+        for pub, cid in self.participants.items():
+            skip = known.get(cid, 0)
+            for hex_id in self.hg.dag.participant_events(pub, skip):
+                out.append(self.hg.dag.events[self.hg.dag.slot_of[hex_id]])
+        out.sort(key=lambda e: e.topological_index)
+        return out
+
+    def to_wire(self, events: List[Event]) -> List[WireEvent]:
+        return [self.hg.to_wire(e) for e in events]
+
+    def from_wire(self, wire_events: List[WireEvent]) -> List[Event]:
+        return [self.hg.read_wire_info(w) for w in wire_events]
+
+    def sync(
+        self,
+        other_head: str,
+        wire_events: List[WireEvent],
+        payload: List[bytes],
+    ) -> None:
+        """Insert peer events, then create the new head (core.go:134-157)."""
+        for w in wire_events:
+            ev = self.hg.read_wire_info(w)
+            if ev.hex() in self.hg.dag.slot_of:
+                continue
+            self.insert_event(ev)
+        ev = new_event(
+            payload, (self.head, other_head), self.key.pub_bytes, self.seq + 1
+        )
+        self.sign_and_insert_self_event(ev)
+
+    def add_self_event(self, payload: List[bytes]) -> None:
+        """Self-parent-only event carrying pooled txs (used when there is
+        nothing to sync but transactions wait; reference core.go:159-169)."""
+        if self.head == "":
+            self.init()
+        ev = new_event(
+            payload, (self.head, self.head), self.key.pub_bytes, self.seq + 1
+        )
+        self.sign_and_insert_self_event(ev)
+
+    # ------------------------------------------------------------------
+
+    def run_consensus(self) -> Tuple[List[Event], Dict[str, float]]:
+        """DivideRounds → DecideFame → FindOrder with per-phase timings
+        (reference core.go:179-202)."""
+        t0 = time.perf_counter()
+        self.hg.divide_rounds()
+        t1 = time.perf_counter()
+        self.hg.decide_fame()
+        t2 = time.perf_counter()
+        new_events = self.hg.find_order()
+        t3 = time.perf_counter()
+        timings = {
+            "divide_rounds_s": t1 - t0,
+            "decide_fame_s": t2 - t1,
+            "find_order_s": t3 - t2,
+        }
+        return new_events, timings
+
+    # ------------------------------------------------------------------
+    # stats (reference core.go:222-256)
+
+    def consensus_events_count(self) -> int:
+        return self.hg.consensus_events_count()
+
+    def consensus_transactions_count(self) -> int:
+        return self.hg.consensus_transactions
+
+    def undetermined_events_count(self) -> int:
+        return self.hg.undetermined_count
+
+    def last_consensus_round(self) -> Optional[int]:
+        return self.hg.last_consensus_round
+
+    def last_committed_round_events_count(self) -> int:
+        return self.hg.last_committed_round_events

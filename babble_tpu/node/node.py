@@ -1,0 +1,255 @@
+"""Node: the gossip event loop (reference node/node.go:35-351).
+
+One asyncio task multiplexes, exactly like the reference's select loop:
+- inbound sync RPCs from the transport consumer,
+- a randomized heartbeat timer triggering outbound gossip,
+- app transactions from the proxy's submit queue (buffered in a pool until
+  the next self-event),
+- commit batches flowing back to the app,
+- shutdown.
+
+Core access is serialized by an asyncio lock (the reference's coreLock);
+consensus itself stays single-threaded while the JAX kernels run batched.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Dict, List, Optional
+
+from ..consensus.engine import TpuHashgraph
+from ..core.event import Event
+from ..crypto.keys import KeyPair
+from ..net.commands import SyncRequest, SyncResponse
+from ..net.peers import Peer, canonical_ids
+from ..net.transport import Transport, TransportError
+from .config import Config
+from .core import Core
+from .peer_selector import RandomPeerSelector
+
+
+class Node:
+    def __init__(
+        self,
+        conf: Config,
+        key: KeyPair,
+        peers: List[Peer],
+        transport: Transport,
+        proxy,
+        engine: Optional[TpuHashgraph] = None,
+    ):
+        self.conf = conf
+        self.logger = conf.logger
+        self.transport = transport
+        self.proxy = proxy
+
+        participants = canonical_ids(peers)
+        self.participants = participants
+        local_addr = transport.local_addr()
+        own_id = participants[key.pub_hex]
+
+        self.core = Core(
+            own_id, key, participants,
+            commit_callback=None, engine=engine,
+            e_cap=max(conf.cache_size, 64),
+        )
+        self.core_lock = asyncio.Lock()
+        self.peer_selector = RandomPeerSelector(peers, local_addr)
+        self.transaction_pool: List[bytes] = []
+
+        self._shutdown = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self._gossip_tasks: set = set()
+        # Commit batches flow through a queue drained by one committer task
+        # (the reference's commitCh, node.go:137-141): batches are enqueued
+        # under the core lock, so the app always sees consensus order even
+        # when gossip tasks overlap.
+        self._commit_queue: "asyncio.Queue[List[Event]]" = asyncio.Queue()
+        self._committer: Optional[asyncio.Task] = None
+
+        # stats counters (the reference declares but never increments its
+        # sync counters, node.go:64-65; here they are real)
+        self.sync_requests = 0
+        self.sync_errors = 0
+        self.start_time = time.monotonic()
+
+    # ------------------------------------------------------------------
+
+    def init(self) -> None:
+        """Create the root event (reference node.go:105-112)."""
+        self.core.init()
+
+    async def run(self, gossip: bool = True) -> None:
+        """The select loop (reference node.go:119-147)."""
+        import time as _time
+
+        consumer = self.transport.consumer
+        if self._committer is None:
+            self._committer = asyncio.create_task(self._commit_loop())
+        # The heartbeat is a fixed deadline, not an idle timeout: inbound
+        # traffic must not postpone outbound gossip (the reference's timer
+        # channel keeps ticking across select iterations, node.go:127-133).
+        deadline = (
+            _time.monotonic() + self._random_timeout() if gossip else None
+        )
+
+        while not self._shutdown.is_set():
+            get_rpc = asyncio.ensure_future(consumer.get())
+            get_tx = asyncio.ensure_future(self.proxy.submit_queue.get())
+            shutdown = asyncio.ensure_future(self._shutdown.wait())
+            waiters = [get_rpc, get_tx, shutdown]
+            timeout = (
+                None if deadline is None
+                else max(0.0, deadline - _time.monotonic())
+            )
+            done, pending = await asyncio.wait(
+                waiters,
+                timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for p in pending:
+                p.cancel()
+            if shutdown in done:
+                break
+            if get_rpc in done:
+                await self._process_rpc(get_rpc.result())
+            if get_tx in done:
+                self.transaction_pool.append(get_tx.result())
+            if gossip and _time.monotonic() >= deadline:
+                peer = self.peer_selector.next()
+                if peer is not None:
+                    t = asyncio.create_task(self._gossip(peer.net_addr))
+                    self._gossip_tasks.add(t)
+                    t.add_done_callback(self._gossip_tasks.discard)
+                deadline = _time.monotonic() + self._random_timeout()
+
+    def run_task(self, gossip: bool = True) -> asyncio.Task:
+        """RunAsync (reference node.go:114-117)."""
+        t = asyncio.create_task(self.run(gossip))
+        self._tasks.append(t)
+        return t
+
+    async def shutdown(self) -> None:
+        self._shutdown.set()
+        committer = [self._committer] if self._committer is not None else []
+        for t in list(self._gossip_tasks) + self._tasks + committer:
+            t.cancel()
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.transport.close()
+
+    # ------------------------------------------------------------------
+    # inbound
+
+    async def _process_rpc(self, rpc) -> None:
+        req = rpc.command
+        try:
+            resp = await self._process_sync_request(req)
+            rpc.respond(resp)
+        except Exception as e:
+            self.logger.warning("sync request failed: %s", e)
+            rpc.respond(None, error=str(e))
+
+    async def _process_sync_request(self, req: SyncRequest) -> SyncResponse:
+        """Diff + wire conversion under the core lock (node.go:160-191)."""
+        async with self.core_lock:
+            diff = self.core.diff(req.known)
+            wire = self.core.to_wire(diff)
+            head = self.core.head
+        return SyncResponse(
+            from_addr=self.transport.local_addr(), head=head, events=wire
+        )
+
+    # ------------------------------------------------------------------
+    # outbound gossip (node.go:193-261)
+
+    async def _gossip(self, peer_addr: str) -> None:
+        try:
+            async with self.core_lock:
+                known = self.core.known()
+            self.sync_requests += 1
+            resp = await self.transport.sync(
+                peer_addr,
+                SyncRequest(
+                    from_addr=self.transport.local_addr(), known=known
+                ),
+                timeout=self.conf.tcp_timeout,
+            )
+            await self._process_sync_response(resp)
+            self.peer_selector.update_last(peer_addr)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # any failure counts against sync_rate
+            self.sync_errors += 1
+            self.logger.warning("gossip to %s failed: %s", peer_addr, e)
+
+    async def _process_sync_response(self, resp: SyncResponse) -> None:
+        async with self.core_lock:
+            payload = self.transaction_pool
+            self.transaction_pool = []
+            try:
+                self.core.sync(resp.head, resp.events, payload)
+            except BaseException:
+                # the sync never produced a self-event carrying the pooled
+                # txs — put them back for the next attempt
+                self.transaction_pool = payload + self.transaction_pool
+                raise
+            new_events, _ = self.core.run_consensus()
+            if new_events:
+                # enqueue under the lock: batches reach the committer in
+                # consensus order even when gossip tasks overlap
+                self._commit_queue.put_nowait(new_events)
+
+    async def _commit_loop(self) -> None:
+        """Deliver consensus transactions to the app, strictly in batch
+        order (reference node.go:263-272 via commitCh)."""
+        while True:
+            events = await self._commit_queue.get()
+            for ev in events:
+                for tx in ev.transactions:
+                    try:
+                        await self.proxy.commit_tx(tx)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        self.logger.warning("commit_tx failed: %s", e)
+
+    def _random_timeout(self) -> float:
+        """Randomized heartbeat pacing (reference node.go:345-351:
+        uniform in [heartbeat, 2*heartbeat))."""
+        hb = self.conf.heartbeat
+        return hb + random.random() * hb
+
+    # ------------------------------------------------------------------
+    # stats (reference node.go:285-343)
+
+    def get_stats(self) -> Dict[str, str]:
+        elapsed = max(time.monotonic() - self.start_time, 1e-9)
+        consensus_events = self.core.consensus_events_count()
+        lcr = self.core.last_consensus_round()
+        rounds = -1 if lcr is None else lcr + 1
+        events_per_sec = consensus_events / elapsed
+        rounds_per_sec = (rounds / elapsed) if rounds > 0 else 0.0
+        total = self.sync_requests
+        sync_rate = 1.0 if total == 0 else 1.0 - self.sync_errors / total
+        return {
+            "last_consensus_round": "nil" if lcr is None else str(lcr),
+            "consensus_events": str(consensus_events),
+            "consensus_transactions": str(
+                self.core.consensus_transactions_count()
+            ),
+            "undetermined_events": str(self.core.undetermined_events_count()),
+            "transaction_pool": str(len(self.transaction_pool)),
+            "num_peers": str(len(self.peer_selector.peers())),
+            "sync_rate": f"{sync_rate:.2f}",
+            "events_per_second": f"{events_per_sec:.2f}",
+            "rounds_per_second": f"{rounds_per_sec:.2f}",
+            "round_events": str(
+                self.core.last_committed_round_events_count()
+            ),
+            "id": str(self.core.id),
+        }

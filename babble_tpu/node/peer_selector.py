@@ -1,0 +1,44 @@
+"""Gossip partner selection (reference node/peer_selector.go:24-61)."""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from ..net.peers import Peer, exclude_peer
+
+
+class PeerSelector:
+    def peers(self) -> List[Peer]:
+        raise NotImplementedError
+
+    def next(self) -> Optional[Peer]:
+        raise NotImplementedError
+
+    def update_last(self, peer_addr: str) -> None:
+        raise NotImplementedError
+
+
+class RandomPeerSelector(PeerSelector):
+    """Uniform choice excluding self and the last-gossiped peer."""
+
+    def __init__(self, peers: List[Peer], local_addr: str,
+                 rng: Optional[random.Random] = None):
+        _, self._peers = exclude_peer(peers, local_addr)
+        self.local_addr = local_addr
+        self.last: Optional[str] = None
+        self._rng = rng or random.Random()
+
+    def peers(self) -> List[Peer]:
+        return list(self._peers)
+
+    def next(self) -> Optional[Peer]:
+        candidates = self._peers
+        if len(candidates) > 1 and self.last is not None:
+            _, candidates = exclude_peer(candidates, self.last)
+        if not candidates:
+            return None
+        return self._rng.choice(candidates)
+
+    def update_last(self, peer_addr: str) -> None:
+        self.last = peer_addr

@@ -1,0 +1,27 @@
+"""In-memory AppProxy (reference proxy/app/inmem_app_proxy.go:21-58)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List
+
+
+class InmemAppProxy:
+    """Test/embedding fake: records committed transactions, feeds submitted
+    ones straight into the node's submit queue."""
+
+    def __init__(self):
+        self.submit_queue: "asyncio.Queue[bytes]" = asyncio.Queue()
+        self.committed: List[bytes] = []
+
+    async def submit_tx(self, tx: bytes) -> None:
+        await self.submit_queue.put(bytes(tx))
+
+    def submit_tx_nowait(self, tx: bytes) -> None:
+        self.submit_queue.put_nowait(bytes(tx))
+
+    async def commit_tx(self, tx: bytes) -> None:
+        self.committed.append(bytes(tx))
+
+    def committed_transactions(self) -> List[bytes]:
+        return list(self.committed)

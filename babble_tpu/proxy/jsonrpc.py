@@ -1,0 +1,160 @@
+"""Minimal JSON-RPC 1.0 over TCP, matching the shape of Go's net/rpc
+jsonrpc codec used by the reference (proxy/app/socket_app_proxy_client.go,
+proxy/babble/socket_babble_proxy_server.go):
+
+request:  {"method": "Service.Method", "params": [arg], "id": N}
+response: {"id": N, "result": ..., "error": null}
+
+Binary payloads ([]byte in Go) travel as base64 strings.  Objects are
+streamed back-to-back on the socket (no framing), so decoding uses an
+incremental raw JSON decoder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import itertools
+from typing import Any, Callable, Dict, Optional
+
+from ..common.aserver import AsyncTcpServer
+
+_decoder = json.JSONDecoder()
+
+MAX_OBJECT_BYTES = 16 << 20  # close the stream rather than buffer forever
+
+
+def b64e(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def b64d(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class JsonStreamError(Exception):
+    """The peer sent bytes that can never become a valid JSON object."""
+
+
+class JsonStream:
+    """Incremental JSON-object reader over an asyncio StreamReader."""
+
+    def __init__(self, reader: asyncio.StreamReader):
+        self.reader = reader
+        self.buf = ""
+
+    async def next_obj(self) -> Optional[dict]:
+        while True:
+            stripped = self.buf.lstrip()
+            if stripped:
+                try:
+                    obj, end = _decoder.raw_decode(stripped)
+                    self.buf = stripped[end:]
+                    return obj
+                except json.JSONDecodeError as e:
+                    # An error before the end of the buffer means the prefix
+                    # itself is invalid — more bytes can never fix it.
+                    if e.pos < len(stripped):
+                        raise JsonStreamError(
+                            f"invalid JSON at byte {e.pos}"
+                        ) from e
+                if len(self.buf) > MAX_OBJECT_BYTES:
+                    raise JsonStreamError("JSON object exceeds size limit")
+            chunk = await self.reader.read(65536)
+            if not chunk:
+                return None
+            self.buf += chunk.decode(errors="replace")
+
+
+class JsonRpcServer:
+    """Serves registered methods over TCP."""
+
+    def __init__(self, bind_addr: str):
+        self.methods: Dict[str, Callable] = {}
+        self._server = AsyncTcpServer(bind_addr, self._handle)
+
+    @property
+    def bind_addr(self) -> str:
+        return self._server.bind_addr
+
+    def register(self, name: str, fn: Callable) -> None:
+        """fn: async (param) -> result"""
+        self.methods[name] = fn
+
+    async def start(self) -> None:
+        await self._server.start()
+
+    async def _handle(self, reader, writer) -> None:
+        stream = JsonStream(reader)
+        try:
+            while True:
+                obj = await stream.next_obj()
+                if obj is None:
+                    return
+                rid = obj.get("id")
+                method = self.methods.get(obj.get("method", ""))
+                if method is None:
+                    resp = {"id": rid, "result": None,
+                            "error": f"unknown method {obj.get('method')}"}
+                else:
+                    try:
+                        params = obj.get("params") or [None]
+                        result = await method(params[0])
+                        resp = {"id": rid, "result": result, "error": None}
+                    except Exception as e:
+                        resp = {"id": rid, "result": None, "error": str(e)}
+                writer.write(json.dumps(resp).encode())
+                await writer.drain()
+        except JsonStreamError:
+            return  # unrecoverable stream; drop the connection
+
+    async def close(self) -> None:
+        await self._server.close()
+
+
+class JsonRpcClient:
+    """Single-connection client with sequential request ids; reconnects on
+    demand (the reference dials per call, socket_app_proxy_client.go:38-47).
+    Calls are serialized by a lock: the stream carries strictly one
+    request/response pair at a time, so responses can't be mis-attributed."""
+
+    def __init__(self, target: str, timeout: float = 5.0):
+        self.target = target
+        self.timeout = timeout
+        self._ids = itertools.count(1)
+        self._conn = None
+        self._lock = asyncio.Lock()
+
+    async def _connect(self):
+        if self._conn is not None and not self._conn[1].is_closing():
+            return self._conn
+        host, port = self.target.rsplit(":", 1)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), self.timeout
+        )
+        self._conn = (reader, writer, JsonStream(reader))
+        return self._conn
+
+    async def call(self, method: str, param: Any) -> Any:
+        async with self._lock:
+            reader, writer, stream = await self._connect()
+            req = {"method": method, "params": [param], "id": next(self._ids)}
+            try:
+                writer.write(json.dumps(req).encode())
+                await writer.drain()
+                resp = await asyncio.wait_for(stream.next_obj(), self.timeout)
+            except (ConnectionError, OSError, JsonStreamError):
+                self._conn = None
+                raise
+            if resp is None:
+                self._conn = None
+                raise ConnectionError("connection closed mid-call")
+            if resp.get("error"):
+                raise RuntimeError(resp["error"])
+            return resp.get("result")
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            self._conn[1].close()
+            self._conn = None

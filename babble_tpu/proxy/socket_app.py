@@ -1,0 +1,42 @@
+"""Babble-side socket AppProxy (reference proxy/app/socket_app_proxy.go).
+
+Runs a JSON-RPC server exposing ``Babble.SubmitTx`` (app → node submit
+queue) and a client calling ``State.CommitTx`` on the app for every
+consensus transaction, requiring an ack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .jsonrpc import JsonRpcClient, JsonRpcServer, b64d, b64e
+
+
+class SocketAppProxy:
+    def __init__(self, client_addr: str, bind_addr: str, timeout: float = 5.0):
+        """client_addr: the app's State server; bind_addr: where we listen
+        for the app's SubmitTx calls."""
+        self.submit_queue: "asyncio.Queue[bytes]" = asyncio.Queue()
+        self.server = JsonRpcServer(bind_addr)
+        self.server.register("Babble.SubmitTx", self._submit_tx)
+        self.client = JsonRpcClient(client_addr, timeout)
+
+    async def start(self) -> None:
+        await self.server.start()
+
+    @property
+    def bind_addr(self) -> str:
+        return self.server.bind_addr
+
+    async def _submit_tx(self, tx_b64: str):
+        await self.submit_queue.put(b64d(tx_b64))
+        return True
+
+    async def commit_tx(self, tx: bytes) -> None:
+        ack = await self.client.call("State.CommitTx", b64e(tx))
+        if ack is not True:
+            raise RuntimeError(f"app failed to ack committed tx: {ack!r}")
+
+    async def close(self) -> None:
+        await self.server.close()
+        await self.client.close()

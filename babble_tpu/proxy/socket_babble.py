@@ -1,0 +1,41 @@
+"""App-side socket BabbleProxy (reference proxy/babble/socket_babble_proxy.go).
+
+Mirror image of SocketAppProxy: a server exposing ``State.CommitTx``
+(node → app commit queue) and a client calling ``Babble.SubmitTx``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .jsonrpc import JsonRpcClient, JsonRpcServer, b64d, b64e
+
+
+class SocketBabbleProxy:
+    def __init__(self, node_addr: str, bind_addr: str, timeout: float = 5.0):
+        """node_addr: the node's SubmitTx server; bind_addr: where we
+        listen for the node's CommitTx calls."""
+        self.commit_queue: "asyncio.Queue[bytes]" = asyncio.Queue()
+        self.server = JsonRpcServer(bind_addr)
+        self.server.register("State.CommitTx", self._commit_tx)
+        self.client = JsonRpcClient(node_addr, timeout)
+
+    async def start(self) -> None:
+        await self.server.start()
+
+    @property
+    def bind_addr(self) -> str:
+        return self.server.bind_addr
+
+    async def _commit_tx(self, tx_b64: str):
+        await self.commit_queue.put(b64d(tx_b64))
+        return True
+
+    async def submit_tx(self, tx: bytes) -> None:
+        ack = await self.client.call("Babble.SubmitTx", b64e(tx))
+        if ack is not True:
+            raise RuntimeError(f"node failed to ack submitted tx: {ack!r}")
+
+    async def close(self) -> None:
+        await self.server.close()
+        await self.client.close()

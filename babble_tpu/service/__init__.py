@@ -1,0 +1,5 @@
+"""Observability service (reference service/service.go:26-58)."""
+
+from .service import Service
+
+__all__ = ["Service"]

@@ -1,0 +1,50 @@
+"""HTTP /Stats endpoint (reference service/service.go:26-58).
+
+A minimal asyncio HTTP server living in the node's event loop, returning
+``node.get_stats()`` as JSON with the reference's stat-key schema.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..common.aserver import AsyncTcpServer
+
+
+class Service:
+    def __init__(self, bind_addr: str, node):
+        self.node = node
+        self._server = AsyncTcpServer(bind_addr, self._handle)
+
+    @property
+    def bind_addr(self) -> str:
+        return self._server.bind_addr
+
+    async def start(self) -> None:
+        await self._server.start()
+
+    async def _handle(self, reader, writer) -> None:
+        request_line = await reader.readline()
+        parts = request_line.decode(errors="replace").split()
+        path = parts[1] if len(parts) >= 2 else "/"
+        # drain headers
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        if path.rstrip("/").lower() in ("/stats", ""):
+            body = json.dumps(self.node.get_stats()).encode()
+            status = "200 OK"
+        else:
+            body = b'{"error": "not found"}'
+            status = "404 Not Found"
+        writer.write(
+            f"HTTP/1.1 {status}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body
+        )
+        await writer.drain()
+
+    async def close(self) -> None:
+        await self._server.close()

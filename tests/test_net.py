@@ -1,0 +1,147 @@
+"""Transport + peers tests (reference net/*_test.go)."""
+
+import asyncio
+
+import pytest
+
+from babble_tpu.core.event import WireEvent
+from babble_tpu.net import (
+    InmemNetwork,
+    JSONPeers,
+    Peer,
+    SyncRequest,
+    SyncResponse,
+    canonical_ids,
+)
+from babble_tpu.net.tcp_transport import new_tcp_transport
+from babble_tpu.net.transport import TransportError
+
+
+def _wire_event(i: int) -> WireEvent:
+    return WireEvent(
+        transactions=[f"tx{i}".encode()],
+        self_parent_index=i - 1,
+        other_parent_creator_id=1,
+        other_parent_index=0,
+        creator_id=0,
+        timestamp=1_700_000_000_000_000_000 + i,
+        index=i,
+        r=12345 + i,
+        s=67890 + i,
+    )
+
+
+async def _echo_handler(transport, n_events: int):
+    rpc = await transport.consumer.get()
+    assert rpc.command.known == {0: 2, 1: 3}
+    rpc.respond(
+        SyncResponse(
+            from_addr=transport.local_addr(),
+            head="0xHEAD",
+            events=[_wire_event(i) for i in range(n_events)],
+        )
+    )
+
+
+def _roundtrip(make_transports):
+    async def go():
+        a, b = await make_transports()
+        handler = asyncio.create_task(_echo_handler(b, 3))
+        resp = await a.sync(
+            b.local_addr(),
+            SyncRequest(from_addr=a.local_addr(), known={0: 2, 1: 3}),
+        )
+        await handler
+        assert resp.head == "0xHEAD"
+        assert len(resp.events) == 3
+        assert resp.events[2].transactions == [b"tx2"]
+        assert resp.events[2].r == 12347
+        await a.close()
+        await b.close()
+
+    asyncio.run(go())
+
+
+def test_inmem_transport_roundtrip():
+    async def make():
+        net = InmemNetwork()
+        return net.transport(), net.transport()
+
+    _roundtrip(make)
+
+
+def test_tcp_transport_roundtrip():
+    async def make():
+        a = await new_tcp_transport("127.0.0.1:0")
+        b = await new_tcp_transport("127.0.0.1:0")
+        return a, b
+
+    _roundtrip(make)
+
+
+def test_tcp_transport_pooling():
+    """Two sequential syncs reuse the pooled connection."""
+
+    async def go():
+        a = await new_tcp_transport("127.0.0.1:0")
+        b = await new_tcp_transport("127.0.0.1:0")
+
+        async def serve_two():
+            for _ in range(2):
+                rpc = await b.consumer.get()
+                rpc.respond(SyncResponse(
+                    from_addr=b.local_addr(), head="h", events=[]
+                ))
+
+        t = asyncio.create_task(serve_two())
+        req = SyncRequest(from_addr=a.local_addr(), known={})
+        await a.sync(b.local_addr(), req)
+        assert len(a._pool[b.local_addr()]) == 1
+        await a.sync(b.local_addr(), req)
+        await t
+        await a.close()
+        await b.close()
+
+    asyncio.run(go())
+
+
+def test_tcp_advertise_validation():
+    with pytest.raises(ValueError):
+        from babble_tpu.net.tcp_transport import TCPTransport
+
+        TCPTransport("0.0.0.0:1337")
+
+
+def test_inmem_disconnect():
+    async def go():
+        net = InmemNetwork()
+        a, b = net.transport(), net.transport()
+        net.disconnect(a.local_addr(), b.local_addr())
+        with pytest.raises(TransportError):
+            await a.sync(
+                b.local_addr(),
+                SyncRequest(from_addr=a.local_addr(), known={}),
+            )
+        net.connect(a.local_addr(), b.local_addr())
+        task = asyncio.create_task(_echo_handler(b, 0))
+        resp = await a.sync(
+            b.local_addr(),
+            SyncRequest(from_addr=a.local_addr(), known={0: 2, 1: 3}),
+        )
+        await task
+        assert resp.head == "0xHEAD"
+
+    asyncio.run(go())
+
+
+def test_json_peers_roundtrip(tmp_path):
+    peers = [
+        Peer(net_addr="127.0.0.1:1", pub_key_hex="0xBB"),
+        Peer(net_addr="127.0.0.1:2", pub_key_hex="0xAA"),
+    ]
+    store = JSONPeers(str(tmp_path))
+    store.set_peers(peers)
+    assert store.peers() == peers
+    # canonical ids sort by pub key — same map on every node
+    ids = canonical_ids(peers)
+    assert ids == {"0xAA": 0, "0xBB": 1}

@@ -1,0 +1,96 @@
+"""Socket proxy + service tests (reference proxy/socket_proxy_test.go)."""
+
+import asyncio
+import json
+
+from babble_tpu.proxy.dummy import DummySocketClient
+from babble_tpu.proxy.socket_app import SocketAppProxy
+from babble_tpu.proxy.socket_babble import SocketBabbleProxy
+
+
+def test_socket_proxy_both_directions():
+    async def go():
+        # node side listens on an ephemeral port; app side likewise
+        app_side_placeholder = "127.0.0.1:1"  # patched after binding
+        node_proxy = SocketAppProxy(app_side_placeholder, "127.0.0.1:0")
+        await node_proxy.start()
+
+        app_proxy = SocketBabbleProxy(node_proxy.bind_addr, "127.0.0.1:0")
+        await app_proxy.start()
+        node_proxy.client.target = app_proxy.bind_addr
+
+        # app -> node: submit
+        await app_proxy.submit_tx(b"the tx")
+        got = await asyncio.wait_for(node_proxy.submit_queue.get(), 5)
+        assert got == b"the tx"
+
+        # node -> app: commit (requires ack)
+        await node_proxy.commit_tx(b"the committed tx")
+        got = await asyncio.wait_for(app_proxy.commit_queue.get(), 5)
+        assert got == b"the committed tx"
+
+        await app_proxy.close()
+        await node_proxy.close()
+
+    asyncio.run(go())
+
+
+def test_dummy_client_writes_messages(tmp_path):
+    async def go():
+        log = tmp_path / "messages.txt"
+        node_proxy = SocketAppProxy("127.0.0.1:1", "127.0.0.1:0")
+        await node_proxy.start()
+        client = DummySocketClient(
+            node_proxy.bind_addr, "127.0.0.1:0", log_path=str(log)
+        )
+        await client.start()
+        node_proxy.client.target = client.proxy.bind_addr
+
+        await client.submit_tx(b"hello world")
+        got = await asyncio.wait_for(node_proxy.submit_queue.get(), 5)
+        assert got == b"hello world"
+
+        await node_proxy.commit_tx(b"hello world")
+        await asyncio.sleep(0.1)
+        assert client.state.get_messages() == ["hello world"]
+        assert log.read_text() == "hello world\n"
+
+        await client.close()
+        await node_proxy.close()
+
+    asyncio.run(go())
+
+
+def test_service_stats_endpoint():
+    async def go():
+        from babble_tpu.crypto.keys import generate_key
+        from babble_tpu.net import InmemNetwork, Peer
+        from babble_tpu.node import Config, Node
+        from babble_tpu.proxy.inmem import InmemAppProxy
+        from babble_tpu.service import Service
+
+        net = InmemNetwork()
+        key = generate_key()
+        t = net.transport()
+        peers = [Peer(net_addr=t.local_addr(), pub_key_hex=key.pub_hex)]
+        node = Node(Config.test_config(), key, peers, t, InmemAppProxy())
+        node.init()
+        svc = Service("127.0.0.1:0", node)
+        await svc.start()
+
+        host, port = svc.bind_addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(b"GET /Stats HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read(65536)
+        writer.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        assert b"200 OK" in head
+        stats = json.loads(body)
+        assert stats["consensus_events"] == "0"
+        assert "events_per_second" in stats
+
+        await svc.close()
+        await node.shutdown()
+
+    asyncio.run(go())
