@@ -35,6 +35,8 @@ def cmd_keygen(args) -> int:
 
 
 async def _run_node(args) -> int:
+    import os
+
     from .crypto.keys import PemKeyFile
     from .net.peers import JSONPeers
     from .net.tcp_transport import new_tcp_transport
@@ -46,6 +48,16 @@ async def _run_node(args) -> int:
 
     key = PemKeyFile(args.datadir).read()
     peers = JSONPeers(args.datadir).peers()
+
+    engine = None
+    ckpt_dir = getattr(args, "checkpoint_dir", "")
+    if ckpt_dir and os.path.isdir(ckpt_dir):
+        from .store import load_checkpoint
+
+        engine = load_checkpoint(ckpt_dir)
+        print(f"resumed from checkpoint {ckpt_dir}: "
+              f"{engine.dag.n_events} events, "
+              f"{engine.consensus_events_count()} in consensus order")
 
     conf = Config(
         heartbeat=args.heartbeat / 1000.0,
@@ -66,18 +78,38 @@ async def _run_node(args) -> int:
                                timeout=conf.tcp_timeout)
         await proxy.start()
 
-    node = Node(conf, key, peers, transport, proxy)
-    node.init()
+    node = Node(conf, key, peers, transport, proxy, engine=engine)
+    if engine is None:
+        node.init()
     service = Service(args.service_addr, node)
     await service.start()
     print(f"node {node.core.id} listening on {transport.local_addr()}, "
           f"stats on http://{service.bind_addr}/Stats")
+
+    saver = None
+    if ckpt_dir:
+        saver = asyncio.create_task(
+            _checkpoint_loop(node, ckpt_dir, args.checkpoint_interval)
+        )
     try:
         await node.run(gossip=True)
     finally:
+        if saver is not None:
+            saver.cancel()
+        if ckpt_dir:
+            await node.save_checkpoint(ckpt_dir)
         await service.close()
         await node.shutdown()
     return 0
+
+
+async def _checkpoint_loop(node, ckpt_dir: str, interval: float) -> None:
+    while True:
+        await asyncio.sleep(interval)
+        try:
+            await node.save_checkpoint(ckpt_dir)
+        except Exception as e:
+            print(f"checkpoint failed: {e}", file=sys.stderr)
 
 
 def cmd_run(args) -> int:
@@ -155,6 +187,10 @@ def main(argv=None) -> int:
     rn.add_argument("--max_pool", type=int, default=2)
     rn.add_argument("--tcp_timeout", type=int, default=1000, help="ms")
     rn.add_argument("--cache_size", type=int, default=500)
+    rn.add_argument("--checkpoint_dir", default="",
+                    help="resume from + periodically checkpoint to this dir")
+    rn.add_argument("--checkpoint_interval", type=float, default=30.0,
+                    help="seconds between checkpoints")
     rn.set_defaults(fn=cmd_run)
 
     sm = sub.add_parser("sim", help="batch consensus over a generated DAG")

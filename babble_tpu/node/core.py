@@ -36,6 +36,13 @@ class Core:
         )
         self.head: str = ""
         self.seq: int = -1
+        # A resumed engine (store.load_checkpoint) already holds our chain —
+        # pick up where the checkpoint left off.
+        chain = self.hg.dag.chains[participants[self.pub_hex]]
+        if chain:
+            head_ev = self.hg.dag.events[chain[-1]]
+            self.head = head_ev.hex()
+            self.seq = head_ev.index
 
     # ------------------------------------------------------------------
 

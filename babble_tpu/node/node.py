@@ -81,6 +81,14 @@ class Node:
         """Create the root event (reference node.go:105-112)."""
         self.core.init()
 
+    async def save_checkpoint(self, path: str) -> None:
+        """Snapshot consensus state under the core lock (see store.checkpoint
+        — persistence the reference's Store seam never implemented)."""
+        from ..store import save_checkpoint
+
+        async with self.core_lock:
+            save_checkpoint(self.core.hg, path)
+
     async def run(self, gossip: bool = True) -> None:
         """The select loop (reference node.go:119-147)."""
         import time as _time

@@ -7,6 +7,10 @@ coordinate tensors) is managed by ``babble_tpu.consensus.engine`` and
 checkpointed via ``babble_tpu.store.checkpoint``.
 """
 
+from .checkpoint import load_checkpoint, save_checkpoint
 from .inmem import InmemStore, RoundEvent, RoundInfo, Store
 
-__all__ = ["Store", "InmemStore", "RoundInfo", "RoundEvent"]
+__all__ = [
+    "Store", "InmemStore", "RoundInfo", "RoundEvent",
+    "save_checkpoint", "load_checkpoint",
+]
