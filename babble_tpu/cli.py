@@ -163,7 +163,111 @@ def cmd_sim(args) -> int:
     return 0
 
 
+async def _run_dummy(args) -> int:
+    from .proxy.dummy import DummySocketClient
+
+    client = DummySocketClient(args.node_addr, args.listen, log_path=args.log)
+    await client.start()
+    if not args.quiet:
+        print(f"dummy client: submit -> {args.node_addr}, "
+              f"commits <- {client.proxy.bind_addr}; type messages:")
+
+    loop = asyncio.get_running_loop()
+    last_seen = 0
+
+    async def print_commits():
+        nonlocal last_seen
+        while True:
+            await asyncio.sleep(0.3)
+            msgs = client.state.get_messages()
+            for m in msgs[last_seen:]:
+                print(f"<< {m}")
+            last_seen = len(msgs)
+
+    printer = None if args.quiet else asyncio.create_task(print_commits())
+    try:
+        if args.quiet:
+            await asyncio.Event().wait()  # serve until killed
+        else:
+            while True:
+                line = await loop.run_in_executor(None, sys.stdin.readline)
+                if not line:
+                    break
+                line = line.strip()
+                if line:
+                    await client.submit_tx(line.encode())
+    finally:
+        if printer is not None:
+            printer.cancel()
+        await client.close()
+    return 0
+
+
+def cmd_dummy(args) -> int:
+    """Interactive chat client (reference cmd/dummy_client/main.go)."""
+    try:
+        return asyncio.run(_run_dummy(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_testnet(args) -> int:
+    from . import testnet as tn
+
+    ports = tn.PortLayout(
+        gossip=args.base_port, submit=args.base_port + 1000,
+        commit=args.base_port + 2000, service=args.base_port + 3000,
+    )
+    if args.testnet_cmd == "conf":
+        dirs = tn.build_conf(args.dir, args.n, ports, overwrite=args.overwrite)
+        print(f"wrote {len(dirs)} node configs under {args.dir}")
+        return 0
+    if args.testnet_cmd == "watch":
+        while True:
+            print("\x1b[2J\x1b[H" + tn.format_stats(
+                tn.watch_once(args.n, ports)))
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    if args.testnet_cmd == "bombard":
+        sent = asyncio.run(
+            tn.bombard(args.n, args.rate, args.duration, ports))
+        print(f"submitted {sent} transactions")
+        return 0
+    if args.testnet_cmd == "run":
+        runner = tn.TestnetRunner(
+            args.dir, args.n, heartbeat_ms=args.heartbeat,
+            with_clients=not args.no_clients, ports=ports,
+        )
+        runner.start()
+        print(f"{args.n} nodes up; /Stats at "
+              f"http://127.0.0.1:{args.base_port + 3000}..{args.base_port + 3000 + args.n - 1}"
+              f"; ctrl-C to stop")
+        try:
+            while True:
+                time.sleep(args.interval)
+                print(tn.format_stats(tn.watch_once(args.n, ports)))
+        except KeyboardInterrupt:
+            pass
+        finally:
+            runner.stop()
+        return 0
+    raise SystemExit(f"unknown testnet subcommand {args.testnet_cmd}")
+
+
 def main(argv=None) -> int:
+    import os
+
+    # Sitecustomize-registered accelerator plugins can take precedence over
+    # JAX_PLATFORMS; this forces the platform through jax.config before any
+    # backend initializes (fleets of local nodes must share the CPU, not
+    # fight over one accelerator).
+    plat = os.environ.get("BABBLE_JAX_PLATFORM", "")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
     p = argparse.ArgumentParser(prog="babble-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -199,6 +303,42 @@ def main(argv=None) -> int:
     sm.add_argument("--rounds", type=int, default=256)
     sm.add_argument("--seed", type=int, default=7)
     sm.set_defaults(fn=cmd_sim)
+
+    dm = sub.add_parser("dummy", help="interactive chat client "
+                        "(reference cmd/dummy_client)")
+    dm.add_argument("--node_addr", default="127.0.0.1:1338",
+                    help="the node's SubmitTx JSON-RPC server")
+    dm.add_argument("--listen", default="127.0.0.1:1339",
+                    help="where we serve the node's CommitTx calls")
+    dm.add_argument("--log", default="messages.txt")
+    dm.add_argument("--quiet", action="store_true",
+                    help="no stdin/stdout chat; just serve commits")
+    dm.set_defaults(fn=cmd_dummy)
+
+    tnp = sub.add_parser("testnet", help="local fleet ops "
+                         "(reference docker/scripts)")
+    tsub = tnp.add_subparsers(dest="testnet_cmd", required=True)
+    for name, hlp in (("conf", "write node datadirs + peers.json"),
+                      ("run", "launch nodes + dummy apps"),
+                      ("watch", "poll fleet /Stats"),
+                      ("bombard", "flood random transactions")):
+        sp = tsub.add_parser(name, help=hlp)
+        sp.add_argument("--n", type=int, default=4)
+        sp.add_argument("--dir", default="testnet-data")
+        sp.add_argument("--base_port", type=int, default=12000)
+        if name == "conf":
+            sp.add_argument("--overwrite", action="store_true")
+        if name == "run":
+            sp.add_argument("--heartbeat", type=int, default=10, help="ms")
+            sp.add_argument("--no_clients", action="store_true")
+            sp.add_argument("--interval", type=float, default=5.0)
+        if name == "watch":
+            sp.add_argument("--interval", type=float, default=2.0)
+            sp.add_argument("--once", action="store_true")
+        if name == "bombard":
+            sp.add_argument("--rate", type=float, default=50.0, help="tx/s")
+            sp.add_argument("--duration", type=float, default=10.0)
+        sp.set_defaults(fn=cmd_testnet)
 
     args = p.parse_args(argv)
     return args.fn(args)

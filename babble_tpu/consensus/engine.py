@@ -72,6 +72,7 @@ class TpuHashgraph:
         self.last_committed_round_events = 0
         self._received: set = set()               # slots already ordered
         self._view: Dict[str, np.ndarray] = {}    # host cache of device arrays
+        self._lcr_cache = -1                      # host mirror for lock-free stats
 
     # ------------------------------------------------------------------
     # properties mirroring the oracle/reference
@@ -87,12 +88,25 @@ class TpuHashgraph:
     def last_consensus_round(self) -> Optional[int]:
         self.flush()
         lcr = int(self.state.lcr)
+        self._lcr_cache = lcr
         return None if lcr < 0 else lcr
 
     @property
     def undetermined_count(self) -> int:
         self.flush()
         return self.dag.n_events - len(self._received)
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        """Lock-free stats from host-side mirrors — safe to call from the
+        stats endpoint while another thread drives the device pipeline
+        (no flush, no device reads)."""
+        return {
+            "last_consensus_round": self._lcr_cache,
+            "undetermined_events": self.dag.n_events - len(self._received),
+            "consensus_events": len(self.consensus),
+            "consensus_transactions": self.consensus_transactions,
+            "last_committed_round_events": self.last_committed_round_events,
+        }
 
     # ------------------------------------------------------------------
     # ingestion
@@ -219,6 +233,7 @@ class TpuHashgraph:
         rr = self._arr("rr")
         cts = self._arr("cts")
         ne = self.dag.n_events
+        self._lcr_cache = int(self.state.lcr)
         new_slots = [
             s for s in range(ne) if rr[s] >= 0 and s not in self._received
         ]
@@ -241,6 +256,7 @@ class TpuHashgraph:
             self.consensus_transactions += len(ev.transactions)
 
         lcr = int(self.state.lcr)
+        self._lcr_cache = lcr
         if lcr >= 1:
             rounds = self._arr("round")
             self.last_committed_round_events = int(

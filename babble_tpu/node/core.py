@@ -146,3 +146,7 @@ class Core:
 
     def last_committed_round_events_count(self) -> int:
         return self.hg.last_committed_round_events
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        """Lock-free host-side counters (see engine.stats_snapshot)."""
+        return self.hg.stats_snapshot()

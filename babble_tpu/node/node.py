@@ -163,11 +163,17 @@ class Node:
             rpc.respond(None, error=str(e))
 
     async def _process_sync_request(self, req: SyncRequest) -> SyncResponse:
-        """Diff + wire conversion under the core lock (node.go:160-191)."""
+        """Diff + wire conversion under the core lock (node.go:160-191).
+        Runs in a worker thread so the event loop keeps serving submits
+        and RPCs while the host index churns; the async lock still
+        serializes all core access."""
+        loop = asyncio.get_running_loop()
         async with self.core_lock:
-            diff = self.core.diff(req.known)
-            wire = self.core.to_wire(diff)
-            head = self.core.head
+            def work():
+                diff = self.core.diff(req.known)
+                return self.core.to_wire(diff), self.core.head
+
+            wire, head = await loop.run_in_executor(None, work)
         return SyncResponse(
             from_addr=self.transport.local_addr(), head=head, events=wire
         )
@@ -196,17 +202,25 @@ class Node:
             self.logger.warning("gossip to %s failed: %s", peer_addr, e)
 
     async def _process_sync_response(self, resp: SyncResponse) -> None:
+        loop = asyncio.get_running_loop()
         async with self.core_lock:
             payload = self.transaction_pool
             self.transaction_pool = []
             try:
-                self.core.sync(resp.head, resp.events, payload)
+                # Device compute (incl. the first jit compile) runs in a
+                # worker thread so the loop keeps serving; the async lock
+                # still serializes all core access.
+                await loop.run_in_executor(
+                    None, self.core.sync, resp.head, resp.events, payload
+                )
             except BaseException:
                 # the sync never produced a self-event carrying the pooled
                 # txs — put them back for the next attempt
                 self.transaction_pool = payload + self.transaction_pool
                 raise
-            new_events, _ = self.core.run_consensus()
+            new_events, _ = await loop.run_in_executor(
+                None, self.core.run_consensus
+            )
             if new_events:
                 # enqueue under the lock: batches reach the committer in
                 # consensus order even when gossip tasks overlap
@@ -214,17 +228,29 @@ class Node:
 
     async def _commit_loop(self) -> None:
         """Deliver consensus transactions to the app, strictly in batch
-        order (reference node.go:263-272 via commitCh)."""
+        order (reference node.go:263-272 via commitCh).  Delivery is
+        at-least-once: transient app failures are retried with backoff —
+        dropping would silently break the app's state-machine ordering."""
         while True:
             events = await self._commit_queue.get()
             for ev in events:
                 for tx in ev.transactions:
-                    try:
-                        await self.proxy.commit_tx(tx)
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception as e:
-                        self.logger.warning("commit_tx failed: %s", e)
+                    delay = 0.2
+                    for attempt in range(8):
+                        try:
+                            await self.proxy.commit_tx(tx)
+                            break
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as e:
+                            self.logger.warning(
+                                "commit_tx failed (attempt %d): %s",
+                                attempt + 1, e,
+                            )
+                            await asyncio.sleep(delay)
+                            delay = min(delay * 2, 3.0)
+                    else:
+                        self.logger.error("commit_tx dropped after retries")
 
     def _random_timeout(self) -> float:
         """Randomized heartbeat pacing (reference node.go:345-351:
@@ -236,28 +262,28 @@ class Node:
     # stats (reference node.go:285-343)
 
     def get_stats(self) -> Dict[str, str]:
+        # Host-side mirrors only (core.stats_snapshot): /Stats must answer
+        # instantly and race-free while a worker thread drives the device
+        # pipeline under the core lock.
+        snap = self.core.stats_snapshot()
         elapsed = max(time.monotonic() - self.start_time, 1e-9)
-        consensus_events = self.core.consensus_events_count()
-        lcr = self.core.last_consensus_round()
-        rounds = -1 if lcr is None else lcr + 1
+        consensus_events = snap["consensus_events"]
+        lcr = snap["last_consensus_round"]
+        rounds = lcr + 1
         events_per_sec = consensus_events / elapsed
         rounds_per_sec = (rounds / elapsed) if rounds > 0 else 0.0
         total = self.sync_requests
         sync_rate = 1.0 if total == 0 else 1.0 - self.sync_errors / total
         return {
-            "last_consensus_round": "nil" if lcr is None else str(lcr),
+            "last_consensus_round": "nil" if lcr < 0 else str(lcr),
             "consensus_events": str(consensus_events),
-            "consensus_transactions": str(
-                self.core.consensus_transactions_count()
-            ),
-            "undetermined_events": str(self.core.undetermined_events_count()),
+            "consensus_transactions": str(snap["consensus_transactions"]),
+            "undetermined_events": str(snap["undetermined_events"]),
             "transaction_pool": str(len(self.transaction_pool)),
             "num_peers": str(len(self.peer_selector.peers())),
             "sync_rate": f"{sync_rate:.2f}",
             "events_per_second": f"{events_per_sec:.2f}",
             "rounds_per_second": f"{rounds_per_sec:.2f}",
-            "round_events": str(
-                self.core.last_committed_round_events_count()
-            ),
+            "round_events": str(snap["last_committed_round_events"]),
             "id": str(self.core.id),
         }

@@ -1,0 +1,229 @@
+"""Local testnet tooling — the docker/terraform scripts, rebuilt as code.
+
+The reference ships its fleet ops as shell around Docker (reference
+docker/makefile:1-28, docker/scripts/build-conf.sh, run-testnet.sh,
+watch.sh, bombard.sh, demo.sh) and Terraform for AWS.  Here the same
+workflow is a library + CLI that works on any host with a Python:
+
+- ``build_conf``  — N keypairs + the shared peers.json   (build-conf.sh)
+- ``TestnetRunner`` — spawn N nodes (+ dummy chat apps) as subprocesses
+  with run-testnet.sh's port layout
+- ``watch``       — poll every node's /Stats into a table (watch.sh)
+- ``bombard``     — flood random transactions at a target rate
+  (bombard.sh, minus the netcat)
+
+Port layout per node i (single host): node gossip 12000+i, node SubmitTx
+13000+i, app CommitTx 14000+i, /Stats 15000+i (overridable).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .crypto.keys import PemKeyFile, generate_key
+from .net.peers import JSONPeers, Peer
+
+
+@dataclass
+class PortLayout:
+    gossip: int = 12000
+    submit: int = 13000
+    commit: int = 14000
+    service: int = 15000
+
+    def of(self, i: int) -> Dict[str, str]:
+        return {
+            "gossip": f"127.0.0.1:{self.gossip + i}",
+            "submit": f"127.0.0.1:{self.submit + i}",
+            "commit": f"127.0.0.1:{self.commit + i}",
+            "service": f"127.0.0.1:{self.service + i}",
+        }
+
+
+def build_conf(base_dir: str, n: int, ports: Optional[PortLayout] = None,
+               overwrite: bool = False) -> List[str]:
+    """Create node datadirs with keys + the shared peers.json
+    (reference docker/scripts/build-conf.sh:1-45)."""
+    ports = ports or PortLayout()
+    if overwrite and os.path.isdir(base_dir):
+        shutil.rmtree(base_dir)
+    keys = []
+    datadirs = []
+    for i in range(n):
+        d = os.path.join(base_dir, f"node{i}")
+        os.makedirs(d, exist_ok=True)
+        pem = PemKeyFile(d)
+        keys.append(pem.read() if pem.exists() else generate_key())
+        if not pem.exists():
+            pem.write(keys[-1])
+        datadirs.append(d)
+    peers = [
+        Peer(net_addr=ports.of(i)["gossip"], pub_key_hex=keys[i].pub_hex)
+        for i in range(n)
+    ]
+    for d in datadirs:
+        JSONPeers(d).set_peers(peers)
+    return datadirs
+
+
+@dataclass
+class TestnetRunner:
+    """Spawn + manage a local fleet (reference docker/scripts/run-testnet.sh;
+    default knobs mirror its heartbeat=10ms, cache_size=50000,
+    tcp_timeout=200ms)."""
+
+    base_dir: str
+    n: int
+    heartbeat_ms: int = 10
+    cache_size: int = 50000
+    tcp_timeout_ms: int = 200
+    with_clients: bool = True
+    ports: PortLayout = field(default_factory=PortLayout)
+    extra_node_args: List[str] = field(default_factory=list)
+    # N processes sharing one host must not fight over a single accelerator;
+    # set to "" to let each node pick its own default platform.
+    jax_platform: str = "cpu"
+
+    procs: List[subprocess.Popen] = field(default_factory=list)
+
+    def start(self) -> None:
+        build_conf(self.base_dir, self.n, self.ports)
+        env = dict(os.environ)
+        if self.jax_platform:
+            env["JAX_PLATFORMS"] = self.jax_platform
+            env["BABBLE_JAX_PLATFORM"] = self.jax_platform
+        for i in range(self.n):
+            p = self.ports.of(i)
+            d = os.path.join(self.base_dir, f"node{i}")
+            args = [
+                sys.executable, "-m", "babble_tpu.cli", "run",
+                "--datadir", d,
+                "--node_addr", p["gossip"],
+                "--proxy_addr", p["submit"],
+                "--client_addr", p["commit"],
+                "--service_addr", p["service"],
+                "--heartbeat", str(self.heartbeat_ms),
+                "--tcp_timeout", str(self.tcp_timeout_ms),
+                "--cache_size", str(self.cache_size),
+                "--log_level", "warning",
+            ] + self.extra_node_args
+            if not self.with_clients:
+                args.append("--no_client")
+            self.procs.append(subprocess.Popen(
+                args, env=env,
+                stdout=open(os.path.join(d, "node.log"), "w"),
+                stderr=subprocess.STDOUT,
+            ))
+            if self.with_clients:
+                self.procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "babble_tpu.cli", "dummy",
+                     "--node_addr", p["submit"],
+                     "--listen", p["commit"],
+                     "--log", os.path.join(d, "messages.txt"),
+                     "--quiet"],
+                    env=env, stdin=subprocess.DEVNULL,
+                    stdout=open(os.path.join(d, "dummy.log"), "w"),
+                    stderr=subprocess.STDOUT,
+                ))
+
+    def stop(self) -> None:
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self.procs.clear()
+
+    def __enter__(self) -> "TestnetRunner":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def fetch_stats(service_addr: str, timeout: float = 3.0) -> Dict[str, str]:
+    with urllib.request.urlopen(
+        f"http://{service_addr}/Stats", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def watch_once(n: int, ports: Optional[PortLayout] = None) -> List[Dict[str, str]]:
+    """One /Stats sweep across the fleet (reference docker/scripts/watch.sh)."""
+    ports = ports or PortLayout()
+    out = []
+    for i in range(n):
+        addr = ports.of(i)["service"]
+        try:
+            out.append(fetch_stats(addr))
+        except OSError as e:
+            out.append({"id": str(i), "error": str(e)})
+    return out
+
+
+def format_stats(rows: List[Dict[str, str]]) -> str:
+    cols = ["id", "consensus_events", "consensus_transactions",
+            "events_per_second", "rounds_per_second", "undetermined_events",
+            "sync_rate"]
+    widths = {c: max(len(c), *(len(str(r.get(c, "?"))) for r in rows))
+              for c in cols}
+    head = "  ".join(c.ljust(widths[c]) for c in cols)
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        if "error" in r:
+            lines.append(f"{r['id'].ljust(widths['id'])}  <{r['error']}>")
+        else:
+            lines.append("  ".join(
+                str(r.get(c, "?")).ljust(widths[c]) for c in cols
+            ))
+    return "\n".join(lines)
+
+
+async def bombard(
+    n: int, rate: float, duration: float,
+    ports: Optional[PortLayout] = None, seed: int = 0,
+) -> int:
+    """Flood random transactions round-robin at ~`rate` tx/s total
+    (reference docker/scripts/bombard.sh).  Returns the count submitted."""
+    import random
+
+    from .proxy.jsonrpc import JsonRpcClient, b64e
+
+    ports = ports or PortLayout()
+    rng = random.Random(seed)
+    # generous timeout: a node may be mid-jit-compile for its first syncs
+    clients = [
+        JsonRpcClient(ports.of(i)["submit"], timeout=15.0) for i in range(n)
+    ]
+    sent = 0
+    attempt = 0
+    t_end = time.monotonic() + duration
+    try:
+        while time.monotonic() < t_end:
+            i = attempt % n
+            attempt += 1
+            payload = f"bomb-{sent}-{rng.getrandbits(32):08x}".encode()
+            try:
+                await clients[i].call("Babble.SubmitTx", b64e(payload))
+                sent += 1
+            except (OSError, RuntimeError):
+                # node not up (yet) — move on to the next one
+                await asyncio.sleep(0.05)
+                continue
+            await asyncio.sleep(1.0 / rate)
+    finally:
+        for c in clients:
+            await c.close()
+    return sent

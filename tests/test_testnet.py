@@ -1,0 +1,97 @@
+"""Fleet-ops tests (reference docker/scripts workflow: build-conf ->
+run-testnet -> bombard -> watch)."""
+
+import asyncio
+import os
+
+import pytest
+
+from babble_tpu import testnet as tn
+
+
+def test_build_conf_is_idempotent(tmp_path):
+    base = str(tmp_path / "net")
+    dirs = tn.build_conf(base, 3)
+    keys1 = [open(os.path.join(d, "priv_key.pem")).read() for d in dirs]
+    # second run must keep existing keys (a fleet's identity is its keys)
+    tn.build_conf(base, 3)
+    keys2 = [open(os.path.join(d, "priv_key.pem")).read() for d in dirs]
+    assert keys1 == keys2
+    # all nodes share one peers.json naming every gossip address
+    import json
+
+    peers = json.load(open(os.path.join(dirs[0], "peers.json")))
+    assert len(peers) == 3
+    assert json.load(open(os.path.join(dirs[1], "peers.json"))) == peers
+
+
+@pytest.mark.slow
+def test_testnet_end_to_end(tmp_path):
+    """4-node fleet + dummy apps + bombard + watch — the reference demo
+    workflow (docker/makefile) on one host, no containers."""
+    ports = tn.PortLayout(gossip=22000, submit=23000, commit=24000,
+                          service=25000)
+    runner = tn.TestnetRunner(
+        str(tmp_path / "net"), 4, heartbeat_ms=20, ports=ports,
+    )
+    with runner:
+        import socket
+        import time
+
+        # wait for the whole fleet to accept transactions (JAX import
+        # dominates node boot, ~15s)
+        deadline = time.time() + 180
+        for i in range(4):
+            addr = ports.of(i)["submit"]
+            host, port = addr.rsplit(":", 1)
+            while True:
+                try:
+                    socket.create_connection((host, int(port)), 0.5).close()
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise RuntimeError(f"node {i} never came up")
+                    time.sleep(0.5)
+
+        sent = asyncio.run(
+            tn.bombard(4, rate=100.0, duration=6.0, ports=ports)
+        )
+        assert sent >= 10
+
+        # watch until every node has committed everything that was sent
+        import time
+
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            rows = tn.watch_once(4, ports)
+            done = [
+                r for r in rows
+                if "error" not in r and int(r["consensus_transactions"]) >= sent
+            ]
+            if len(done) == 4:
+                break
+            time.sleep(1.0)
+        else:
+            raise AssertionError(f"fleet never converged: {rows}")
+
+        table = tn.format_stats(rows)
+        assert "consensus_events" in table
+
+        # all apps eventually wrote every tx, in identical order
+        def read_logs():
+            out = []
+            for i in range(4):
+                p = tmp_path / "net" / f"node{i}" / "messages.txt"
+                out.append(p.read_text().splitlines() if p.exists() else [])
+            return out
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            logs = read_logs()
+            if min(len(l) for l in logs) >= sent:
+                break
+            time.sleep(1.0)
+        k = min(len(l) for l in logs)
+        assert k >= sent, f"app logs lag: {[len(l) for l in logs]} < {sent}"
+        for l in logs[1:]:
+            assert l[:k] == logs[0][:k]
