@@ -145,6 +145,14 @@ def cmd_sim(args) -> int:
     out = step(init_state(cfg), batch)
     jax.block_until_ready(out)
     compile_s = time.perf_counter() - t0
+    if args.profile:
+        # xprof trace of the steady-state step (reference piggy-backs Go
+        # pprof on its HTTP listener, cmd/main.go:26; the TPU equivalent
+        # is a jax profiler trace viewable in tensorboard/xprof)
+        with jax.profiler.trace(args.profile):
+            out = step(init_state(cfg), batch)
+            jax.block_until_ready(out)
+        print(f"profile written to {args.profile}", file=sys.stderr)
     t0 = time.perf_counter()
     out = step(init_state(cfg), batch)
     jax.block_until_ready(out)
@@ -302,6 +310,8 @@ def main(argv=None) -> int:
     sm.add_argument("--events", type=int, default=16384)
     sm.add_argument("--rounds", type=int, default=256)
     sm.add_argument("--seed", type=int, default=7)
+    sm.add_argument("--profile", default="",
+                    help="write a jax profiler (xprof) trace to this dir")
     sm.set_defaults(fn=cmd_sim)
 
     dm = sub.add_parser("dummy", help="interactive chat client "

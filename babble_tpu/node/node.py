@@ -74,6 +74,10 @@ class Node:
         self.sync_requests = 0
         self.sync_errors = 0
         self.start_time = time.monotonic()
+        # last-gossip phase timings in ms (the reference logs ns durations
+        # per phase, node.go:166-255, core.go:180-196; here they are part
+        # of the stats schema so /Stats exposes them fleet-wide)
+        self.timings: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
 
@@ -206,6 +210,7 @@ class Node:
         async with self.core_lock:
             payload = self.transaction_pool
             self.transaction_pool = []
+            t0 = time.perf_counter()
             try:
                 # Device compute (incl. the first jit compile) runs in a
                 # worker thread so the loop keeps serving; the async lock
@@ -218,8 +223,23 @@ class Node:
                 # txs — put them back for the next attempt
                 self.transaction_pool = payload + self.transaction_pool
                 raise
-            new_events, _ = await loop.run_in_executor(
+            t1 = time.perf_counter()
+            new_events, phase_timings = await loop.run_in_executor(
                 None, self.core.run_consensus
+            )
+            t2 = time.perf_counter()
+            self.timings = {
+                "sync_ms": (t1 - t0) * 1e3,
+                "consensus_ms": (t2 - t1) * 1e3,
+                **{
+                    k.replace("_s", "_ms"): v * 1e3
+                    for k, v in phase_timings.items()
+                },
+            }
+            self.logger.debug(
+                "sync %d events in %.1fms, consensus %.1fms",
+                len(resp.events), self.timings["sync_ms"],
+                self.timings["consensus_ms"],
             )
             if new_events:
                 # enqueue under the lock: batches reach the committer in
@@ -286,4 +306,5 @@ class Node:
             "rounds_per_second": f"{rounds_per_sec:.2f}",
             "round_events": str(snap["last_committed_round_events"]),
             "id": str(self.core.id),
+            **{k: f"{v:.2f}" for k, v in self.timings.items()},
         }
