@@ -125,21 +125,20 @@ def cmd_sim(args) -> int:
     import jax
     import numpy as np
 
-    from .consensus.engine import TpuHashgraph
+    from .ops.state import DagConfig, init_state
     from .parallel.sharded import consensus_step_impl
-    from .ops.state import init_state
-    from .sim.generator import random_gossip_dag
+    from .sim.arrays import batch_from_arrays, random_gossip_arrays
 
-    dag = random_gossip_dag(args.nodes, args.events, seed=args.seed)
-    eng = TpuHashgraph(
-        dag.participants, verify_signatures=False,
-        e_cap=args.events, s_cap=max(64, 2 * args.events // args.nodes),
-        r_cap=args.rounds,
+    t0 = time.perf_counter()
+    dag = random_gossip_arrays(args.nodes, args.events, seed=args.seed)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(
+        n=args.nodes, e_cap=args.events,
+        s_cap=max(64, dag.max_chain + 1), r_cap=args.rounds,
     )
-    for ev in dag.events:
-        eng.insert_event(ev)
-    batch, _ = eng.build_batch()
-    cfg = eng.cfg
+    print(f"host build: {time.perf_counter()-t0:.2f}s "
+          f"(native={__import__('babble_tpu.native', fromlist=['x']).available()})",
+          file=sys.stderr)
     step = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))
     t0 = time.perf_counter()
     out = step(init_state(cfg), batch)

@@ -1,0 +1,85 @@
+"""Native (C++) host components, loaded via ctypes.
+
+The reference is pure Go with no native layer (SURVEY.md §2); here the
+performance-critical host-side pieces — bulk DAG generation and level
+scheduling for simulation/benchmark scale — are C++, compiled on first use
+with the toolchain baked into the image.  Every native entry point has a
+pure-Python/numpy fallback with identical output (differentially tested),
+so the framework works even without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+_DIR = Path(__file__).parent
+_BUILD = _DIR / "_build"
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _compile(src: Path, out: Path) -> None:
+    out.parent.mkdir(exist_ok=True)
+    # build into a temp file then rename: concurrent processes (a testnet
+    # fleet booting) must never dlopen a half-written .so
+    fd, tmp = tempfile.mkstemp(dir=str(out.parent), suffix=".so")
+    os.close(fd)
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        str(src), "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The graph-builder library, or None if no toolchain is available."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    src = _DIR / "graph_builder.cpp"
+    so = _BUILD / "graph_builder.so"
+    try:
+        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+            _compile(src, so)
+        lib = ctypes.CDLL(str(so))
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    lib.gossip_dag.restype = ctypes.c_long
+    lib.gossip_dag.argtypes = [
+        ctypes.c_uint64, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64,
+        i32p, i32p, i32p, i32p, i64p, u8p, i32p, i32p,
+    ]
+    lib.build_schedule.restype = ctypes.c_int32
+    lib.build_schedule.argtypes = [
+        i32p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+    ]
+    lib.max_level_width.restype = ctypes.c_int32
+    lib.max_level_width.argtypes = [i32p, ctypes.c_int64, ctypes.c_int32, i32p]
+
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -5,6 +5,16 @@ DAGs at scale (uniform arrival; byzantine-fork variants planned), push them
 through the TPU engine in batch, and measure events/sec to consensus order.
 """
 
+from .arrays import (
+    ArrayDag,
+    batch_from_arrays,
+    build_schedule,
+    random_gossip_arrays,
+)
 from .generator import GeneratedDag, random_gossip_dag
 
-__all__ = ["GeneratedDag", "random_gossip_dag"]
+__all__ = [
+    "GeneratedDag", "random_gossip_dag",
+    "ArrayDag", "random_gossip_arrays", "build_schedule",
+    "batch_from_arrays",
+]

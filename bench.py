@@ -1,10 +1,13 @@
 """Headline benchmark: consensus events/sec to full order on one chip.
 
-Workload: a 64-participant / 16384-event random-gossip DAG (the same shape
-babble's TestGossip produces live) pushed through the whole device pipeline
-— coordinate ingest, round division, fame voting, order + timestamps — as
-one jitted step.  Reported value is events brought to consensus order per
-second of device wall time (median of repeats, post-compile).
+Workload: a 64-participant / 65536-event random-gossip DAG (the shape
+babble's TestGossip produces live, reference node/node_test.go:405-450)
+pushed through the whole device pipeline — coordinate ingest, round
+division, fame voting, order + timestamps — as one jitted step.  The host
+side is array-native (C++ graph builder, babble_tpu/native) so the
+measurement isolates the consensus engine.  Reported value is events
+brought to consensus order per second of device wall time (median of
+repeats, post-compile).
 
 Baseline: the reference's only published figure, 264.65 consensus events/s
 on its 4-node Docker testnet (reference README.md:154; see BASELINE.md).
@@ -22,8 +25,8 @@ import time
 BASELINE_EVENTS_PER_SEC = 264.65
 
 N = 64
-E = 16384
-R_CAP = 256
+E = 65536
+R_CAP = 512
 REPEATS = 3
 
 
@@ -32,30 +35,23 @@ def log(*a):
 
 
 def main() -> None:
-    from babble_tpu.consensus.engine import TpuHashgraph
-    from babble_tpu.ops.state import init_state
-    from babble_tpu.parallel.sharded import consensus_step_impl
-    from babble_tpu.sim.generator import random_gossip_dag
-
     import jax
     import numpy as np
 
+    from babble_tpu import native
+    from babble_tpu.ops.state import DagConfig, init_state
+    from babble_tpu.parallel.sharded import consensus_step_impl
+    from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
     log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
-    dag = random_gossip_dag(N, E, seed=7)
-    log(f"generated {E} events over {N} participants "
-        f"in {time.perf_counter()-t0:.1f}s")
-
-    eng = TpuHashgraph(
-        dag.participants, verify_signatures=False,
-        e_cap=E, s_cap=1024, r_cap=R_CAP,
+    dag = random_gossip_arrays(N, E, seed=7)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(
+        n=N, e_cap=E, s_cap=max(64, dag.max_chain + 1), r_cap=R_CAP
     )
-    t0 = time.perf_counter()
-    for ev in dag.events:
-        eng.insert_event(ev)
-    batch, _ = eng.build_batch()
-    cfg = eng.cfg  # build_batch may have grown capacities
-    log(f"host index + batch build: {time.perf_counter()-t0:.1f}s; cfg {cfg}")
+    log(f"host build (native={native.available()}): "
+        f"{time.perf_counter()-t0:.2f}s; {dag.n_levels} levels; cfg {cfg}")
 
     step = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))
 
@@ -63,7 +59,7 @@ def main() -> None:
     out = step(init_state(cfg), batch)
     jax.block_until_ready(out)
     log(f"compile + first run: {time.perf_counter()-t0:.1f}s")
-    ordered = int(np.count_nonzero(np.asarray(out.rr)[: E] >= 0))
+    ordered = int(np.count_nonzero(np.asarray(out.rr)[:E] >= 0))
     lcr = int(out.lcr)
     log(f"ordered {ordered}/{E} events, last consensus round {lcr}, "
         f"max round {int(out.max_round)}")
