@@ -139,7 +139,7 @@ def cmd_sim(args) -> int:
     print(f"host build: {time.perf_counter()-t0:.2f}s "
           f"(native={__import__('babble_tpu.native', fromlist=['x']).available()})",
           file=sys.stderr)
-    step = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))
+    step = jax.jit(functools.partial(consensus_step_impl, cfg, "fast"))
     t0 = time.perf_counter()
     out = step(init_state(cfg), batch)
     jax.block_until_ready(out)

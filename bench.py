@@ -53,7 +53,7 @@ def main() -> None:
     log(f"host build (native={native.available()}): "
         f"{time.perf_counter()-t0:.2f}s; {dag.n_levels} levels; cfg {cfg}")
 
-    step = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))
+    step = jax.jit(functools.partial(consensus_step_impl, cfg, "fast"))
 
     t0 = time.perf_counter()
     out = step(init_state(cfg), batch)
