@@ -37,7 +37,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .state import INT32_MAX, DagConfig, DagState, I32, I64, sanitize
+from .state import (
+    INT32_MAX, DagConfig, DagState, I32, I64, sanitize, set_sentinel,
+)
 
 
 class EventBatch(NamedTuple):
@@ -56,24 +58,35 @@ class EventBatch(NamedTuple):
 
 def _reset_event_sentinels(state: DagState, cfg: DagConfig) -> DagState:
     """Padding lanes dump writes into the last row/col of each array; restore
-    the sentinel values afterwards so gathers of missing refs stay neutral."""
+    the sentinel values afterwards so gathers of missing refs stay neutral.
+
+    Uses ``set_sentinel`` (elementwise selects over iota masks) — see its
+    docstring for why ``.at[sentinel].set()`` corrupts sharded arrays
+    (observed: ce/cnt rows wiped at the clamped index on an ("ev","p")
+    mesh)."""
     e, n, s, r = cfg.e_cap, cfg.n, cfg.s_cap, cfg.r_cap
+    e_row = jnp.arange(e + 1) == e        # [E+1]
+    n_row = jnp.arange(n + 1) == n        # [N+1]
+    s_col = jnp.arange(s + 1) == s        # [S+1]
+    r_row = jnp.arange(r + 1) == r        # [R+1]
+    setv = set_sentinel
+
     return state._replace(
-        sp=state.sp.at[e].set(-1),
-        op=state.op.at[e].set(-1),
-        creator=state.creator.at[e].set(n),
-        seq=state.seq.at[e].set(-1),
-        ts=state.ts.at[e].set(0),
-        mbit=state.mbit.at[e].set(False),
-        la=state.la.at[e].set(-1),
-        fd=state.fd.at[e].set(INT32_MAX),
-        round=state.round.at[e].set(-1),
-        witness=state.witness.at[e].set(False),
-        rr=state.rr.at[e].set(-1),
-        cts=state.cts.at[e].set(0),
-        ce=state.ce.at[n, :].set(-1).at[:, s].set(-1),
-        cnt=state.cnt.at[n].set(0),
-        wslot=state.wslot.at[r].set(-1),
+        sp=setv(state.sp, e_row, -1),
+        op=setv(state.op, e_row, -1),
+        creator=setv(state.creator, e_row, n),
+        seq=setv(state.seq, e_row, -1),
+        ts=setv(state.ts, e_row, 0),
+        mbit=setv(state.mbit, e_row, False),
+        la=setv(state.la, e_row[:, None], -1),
+        fd=setv(state.fd, e_row[:, None], INT32_MAX),
+        round=setv(state.round, e_row, -1),
+        witness=setv(state.witness, e_row, False),
+        rr=setv(state.rr, e_row, -1),
+        cts=setv(state.cts, e_row, 0),
+        ce=setv(state.ce, n_row[:, None] | s_col[None, :], -1),
+        cnt=setv(state.cnt, n_row, 0),
+        wslot=setv(state.wslot, r_row[:, None], -1),
     )
 
 
@@ -200,7 +213,8 @@ def _fd_full(state: DagState, cfg: DagConfig) -> DagState:
         s_idx[None, :] < cnt[:, None], cej, cfg.e_cap
     )                                                            # [N, S+1]
     fd_new = state.fd.at[tgt].set(out_ctj)
-    return state._replace(fd=fd_new.at[cfg.e_cap].set(INT32_MAX))
+    e_row = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
+    return state._replace(fd=set_sentinel(fd_new, e_row, INT32_MAX))
 
 
 def _rounds_level_scan(
@@ -262,12 +276,21 @@ def _la_init_direct(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
     rows = jnp.full((kpad, cfg.n), -1, I32)
     own = jnp.clip(b.creator, 0, cfg.n - 1)
     rows = rows.at[jnp.arange(kpad), own].max(b.seq)
+    # Missing parents (slot -1) must contribute nothing.  The sentinel row is
+    # NOT trustworthy here: this runs right after _write_batch_fields, whose
+    # padded lanes dumped zero-filled creator/seq into row e_cap — gathering
+    # it would plant a phantom "sees creator 0 at seq 0" on every root event.
+    # Mask on parent validity instead.
     spx = sanitize(b.sp, cfg.e_cap)
     opx = sanitize(b.op, cfg.e_cap)
     sp_c = jnp.clip(state.creator[spx], 0, cfg.n - 1)
     op_c = jnp.clip(state.creator[opx], 0, cfg.n - 1)
-    rows = rows.at[jnp.arange(kpad), sp_c].max(state.seq[spx])
-    rows = rows.at[jnp.arange(kpad), op_c].max(state.seq[opx])
+    sp_seq = jnp.where(b.sp >= 0, state.seq[spx], -1)
+    op_seq = jnp.where(b.op >= 0, state.seq[opx], -1)
+    rows = rows.at[jnp.arange(kpad), sp_c].max(sp_seq)
+    rows = rows.at[jnp.arange(kpad), op_c].max(op_seq)
+    # Padded lanes all dump into the sentinel row; their rows must stay -1.
+    rows = jnp.where(real[:, None], rows, -1)
     return state._replace(la=state.la.at[slots].set(rows))
 
 

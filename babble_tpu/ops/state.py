@@ -156,3 +156,50 @@ def grow_state(state: DagState, old: DagConfig, new: DagConfig) -> DagState:
 def sanitize(idx: jnp.ndarray, sentinel: int) -> jnp.ndarray:
     """Remap negative (missing) indices to the sentinel row."""
     return jnp.where(idx < 0, sentinel, idx)
+
+
+def set_sentinel(a: jnp.ndarray, mask: jnp.ndarray, v) -> jnp.ndarray:
+    """SPMD-safe sentinel write: ``where(mask, v, a)`` over an iota mask.
+
+    NEVER restore a sentinel row of a (possibly sharded) array with
+    ``a.at[row].set(v)``: the static-index write lowers to a
+    dynamic-update-slice whose per-shard start index is *clamped* into each
+    shard's local range under SPMD partitioning, so the write also lands on
+    the last row of every earlier shard and corrupts real data.  Elementwise
+    selects partition trivially.  Build ``mask`` as
+    ``jnp.arange(dim) == sentinel`` (broadcast to the array's rank)."""
+    return jnp.where(mask, jnp.asarray(v, a.dtype), a)
+
+
+# Consensus-observable tensors: every decision the pipeline emits.  The
+# single source of truth for bit-parity checks (fd-mode differentials,
+# sharded-vs-single-chip, the driver's multi-chip dry-run).
+CONSENSUS_EVENT_FIELDS = ("la", "fd", "round", "witness", "rr", "cts")
+CONSENSUS_TABLE_FIELDS = ("wslot", "famous")
+
+
+def assert_consensus_parity(ref: DagState, out: DagState, n_events: int,
+                            label: str = "") -> None:
+    """Assert bit-identical consensus decisions between two DagStates
+    (per-event fields compared on the first n_events rows)."""
+    for f in CONSENSUS_EVENT_FIELDS:
+        a = np.asarray(getattr(ref, f))[:n_events]
+        b = np.asarray(getattr(out, f))[:n_events]
+        if not (a == b).all():
+            raise AssertionError(
+                f"consensus parity broken{label and f' ({label})'}: "
+                f"{f} differs on {int((a != b).sum())}/{a.size} entries"
+            )
+    for f in CONSENSUS_TABLE_FIELDS:
+        a = np.asarray(getattr(ref, f))
+        b = np.asarray(getattr(out, f))
+        if not (a == b).all():
+            raise AssertionError(
+                f"consensus parity broken{label and f' ({label})'}: "
+                f"{f} differs on {int((a != b).sum())}/{a.size} entries"
+            )
+    if int(ref.lcr) != int(out.lcr):
+        raise AssertionError(
+            f"consensus parity broken{label and f' ({label})'}: "
+            f"lcr {int(ref.lcr)} != {int(out.lcr)}"
+        )

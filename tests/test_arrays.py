@@ -57,6 +57,32 @@ def test_build_schedule_levels():
         assert (dag.levels[lv] == row).all()
 
 
+@pytest.mark.parametrize("fd_mode", ["fast", "absorb", "incremental"])
+def test_fd_modes_match_full(fd_mode):
+    """Every selectable fd_mode of ingest_impl must produce bit-identical
+    consensus tensors to the 'full' reference path.  Regression: 'absorb'
+    once planted phantom la entries from sentinel-row junk (round-1 bug)."""
+    import jax
+
+    from babble_tpu.ops.state import (
+        DagConfig, assert_consensus_parity, init_state,
+    )
+    from babble_tpu.parallel.sharded import consensus_step_impl
+
+    n, e = 6, 300
+    dag = random_gossip_arrays(n, e, seed=11)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 1, r_cap=32)
+    batch = batch_from_arrays(dag)
+
+    ref = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))(
+        init_state(cfg), batch
+    )
+    out = jax.jit(functools.partial(consensus_step_impl, cfg, fd_mode))(
+        init_state(cfg), batch
+    )
+    assert_consensus_parity(ref, out, e, label=f"fd_mode={fd_mode}")
+
+
 def test_array_path_matches_engine_path():
     """The zero-object batch must reach the same consensus tensors as the
     Event-object engine on an identical DAG.  (Coin-round mbit sources

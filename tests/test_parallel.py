@@ -29,8 +29,11 @@ def _single_chip(dag, caps):
     return eng
 
 
-@pytest.mark.parametrize("n_part", [6, 8])  # 6: pads N to the p=2 axis
-def test_sharded_step_matches_single_chip(n_part):
+@pytest.mark.parametrize(
+    "n_part,fd_mode",
+    [(6, "full"), (8, "full"), (6, "fast")],  # n=6 pads N to the p=2 axis
+)
+def test_sharded_step_matches_single_chip(n_part, fd_mode):
     assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
     caps = dict(e_cap=255, s_cap=64, r_cap=32)
     dag = random_gossip_dag(n_part, 180, seed=5)
@@ -51,7 +54,7 @@ def test_sharded_step_matches_single_chip(n_part):
                   r_cap=eng.cfg.r_cap),
         mesh,
     )
-    step = make_sharded_step(cfg, mesh, "full")
+    step = make_sharded_step(cfg, mesh, fd_mode)
     out = step(sharded_init_state(cfg, mesh), batch)
 
     assert int(out.n_events) == ne
