@@ -20,6 +20,17 @@ from .transport import RPC, Transport, TransportError
 _HDR = struct.Struct(">BI")
 _RHDR = struct.Struct(">BI")
 
+# Inbound/outbound frame-size ceiling.  A u32 length would otherwise let a
+# single malformed or hostile frame drive a 4 GiB readexactly allocation;
+# the gossip port is at least as exposed as the JSON-RPC proxy (which caps
+# at 16 MB, proxy/jsonrpc.py).  Sync payloads are event diffs — far below
+# this in any honest configuration.
+MAX_FRAME = 16 * 1024 * 1024
+
+
+class FrameTooLarge(TransportError):
+    pass
+
 
 class TCPTransport(Transport):
     def __init__(
@@ -74,12 +85,27 @@ class TCPTransport(Transport):
             except (asyncio.IncompleteReadError, ConnectionError):
                 return
             rtype, ln = _HDR.unpack(hdr)
+            if ln > MAX_FRAME:
+                # oversized frame: close without allocating — the stream
+                # can't be resynchronized anyway
+                writer.close()
+                return
             payload = await reader.readexactly(ln)
             if rtype != RPC_SYNC:
                 writer.write(_RHDR.pack(1, 0) + b"")
                 await writer.drain()
                 continue
-            rpc = RPC(command=SyncRequest.unpack(payload))
+            try:
+                cmd = SyncRequest.unpack(payload)
+            except Exception:
+                # malformed payload: report an error frame and drop the
+                # connection (framing state is untrustworthy)
+                msg = b"malformed sync request"
+                writer.write(_RHDR.pack(1, len(msg)) + msg)
+                await writer.drain()
+                writer.close()
+                return
+            rpc = RPC(command=cmd)
             await self._consumer.put(rpc)
             try:
                 resp = await asyncio.wait_for(rpc.response(), self.timeout)
@@ -127,6 +153,10 @@ class TCPTransport(Transport):
                 reader.readexactly(_RHDR.size), timeout
             )
             ok, ln = _RHDR.unpack(hdr)
+            if ln > MAX_FRAME:
+                raise FrameTooLarge(
+                    f"response frame of {ln} bytes exceeds {MAX_FRAME}"
+                )
             payload = await asyncio.wait_for(reader.readexactly(ln), timeout)
             if ok != 0:
                 raise TransportError(payload.decode(errors="replace"))

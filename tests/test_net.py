@@ -145,3 +145,71 @@ def test_json_peers_roundtrip(tmp_path):
     # canonical ids sort by pub key — same map on every node
     ids = canonical_ids(peers)
     assert ids == {"0xAA": 0, "0xBB": 1}
+
+
+def test_tcp_oversized_frame_closes_connection():
+    """A frame header claiming > MAX_FRAME bytes must close the connection
+    without allocating; the server must stay healthy for other clients."""
+    import struct
+
+    from babble_tpu.net.tcp_transport import MAX_FRAME, _HDR
+
+    async def go():
+        b = await new_tcp_transport("127.0.0.1:0")
+        host, port = b.bind_addr.rsplit(":", 1)
+
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(_HDR.pack(0, MAX_FRAME + 1))
+        await writer.drain()
+        # server closes without reading the (absent) payload
+        eof = await asyncio.wait_for(reader.read(1), 5.0)
+        assert eof == b""
+        writer.close()
+
+        # the transport still serves honest clients
+        a = await new_tcp_transport("127.0.0.1:0")
+
+        async def serve_one():
+            rpc = await b.consumer.get()
+            rpc.respond(SyncResponse(
+                from_addr=b.local_addr(), head="h", events=[]
+            ))
+
+        t = asyncio.create_task(serve_one())
+        resp = await a.sync(
+            b.local_addr(), SyncRequest(from_addr=a.local_addr(), known={})
+        )
+        assert resp.head == "h"
+        await t
+        await a.close()
+        await b.close()
+
+    asyncio.run(go())
+
+
+def test_tcp_malformed_payload_rejected():
+    """Garbage bytes in a sync frame produce an error frame + disconnect,
+    not a crash or a poisoned consumer queue."""
+    from babble_tpu.net.tcp_transport import _HDR, _RHDR
+    from babble_tpu.net.commands import RPC_SYNC
+
+    async def go():
+        b = await new_tcp_transport("127.0.0.1:0")
+        host, port = b.bind_addr.rsplit(":", 1)
+
+        reader, writer = await asyncio.open_connection(host, int(port))
+        junk = b"\xff\x00garbage-not-msgpack"
+        writer.write(_HDR.pack(RPC_SYNC, len(junk)) + junk)
+        await writer.drain()
+        hdr = await asyncio.wait_for(reader.readexactly(_RHDR.size), 5.0)
+        ok, ln = _RHDR.unpack(hdr)
+        assert ok == 1
+        msg = await asyncio.wait_for(reader.readexactly(ln), 5.0)
+        assert b"malformed" in msg
+        eof = await asyncio.wait_for(reader.read(1), 5.0)
+        assert eof == b""
+        writer.close()
+        assert b.consumer.empty()
+        await b.close()
+
+    asyncio.run(go())
