@@ -45,19 +45,26 @@ def _compile(src: Path, out: Path) -> None:
         raise
 
 
+def _load_lib(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and dlopen native/<name>.cpp -> _build/<name>.so."""
+    src = _DIR / f"{name}.cpp"
+    so = _BUILD / f"{name}.so"
+    try:
+        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+            _compile(src, so)
+        return ctypes.CDLL(str(so))
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def load() -> Optional[ctypes.CDLL]:
     """The graph-builder library, or None if no toolchain is available."""
     global _lib, _lib_tried
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    src = _DIR / "graph_builder.cpp"
-    so = _BUILD / "graph_builder.so"
-    try:
-        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
-            _compile(src, so)
-        lib = ctypes.CDLL(str(so))
-    except (OSError, subprocess.SubprocessError):
+    lib = _load_lib("graph_builder")
+    if lib is None:
         return None
 
     i32p = ctypes.POINTER(ctypes.c_int32)
@@ -83,3 +90,77 @@ def load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return load() is not None
+
+
+_baseline_lib: Optional[ctypes.CDLL] = None
+_baseline_tried = False
+
+
+def load_baseline() -> Optional[ctypes.CDLL]:
+    """The C++ reference-algorithm consensus baseline (bench-only)."""
+    global _baseline_lib, _baseline_tried
+    if _baseline_lib is not None or _baseline_tried:
+        return _baseline_lib
+    _baseline_tried = True
+    lib = _load_lib("baseline_consensus")
+    if lib is None:
+        return None
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+
+    lib.baseline_consensus.restype = ctypes.c_int64
+    lib.baseline_consensus.argtypes = [
+        ctypes.c_int32, ctypes.c_int64,
+        i32p, i32p, i32p, i32p, i64p, u8p,
+        i32p, u8p, i32p, i64p, i8p,
+    ]
+    _baseline_lib = lib
+    return _baseline_lib
+
+
+def baseline_consensus(dag):
+    """Run the C++ reference-algorithm pipeline over an ArrayDag.
+
+    Returns (ordered_count, dict of output arrays) or None when no
+    toolchain is available.  This is the honest same-machine baseline the
+    benchmark compares against (BASELINE.md's re-measurement requirement);
+    correctness is differentially tested against the TPU engine."""
+    import numpy as np
+
+    lib = load_baseline()
+    if lib is None:
+        return None
+    e = int(dag.n_events)
+    rnd = np.empty(e, np.int32)
+    wit = np.empty(e, np.uint8)
+    rr = np.empty(e, np.int32)
+    cts = np.empty(e, np.int64)
+    fame = np.empty(e, np.int8)
+
+    def p(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    sp = np.ascontiguousarray(dag.sp, np.int32)
+    op = np.ascontiguousarray(dag.op, np.int32)
+    creator = np.ascontiguousarray(dag.creator, np.int32)
+    seq = np.ascontiguousarray(dag.seq, np.int32)
+    ts = np.ascontiguousarray(dag.ts, np.int64)
+    mbit = np.ascontiguousarray(dag.mbit, np.uint8)
+    ordered = lib.baseline_consensus(
+        int(dag.n), e,
+        p(sp, ctypes.c_int32), p(op, ctypes.c_int32),
+        p(creator, ctypes.c_int32), p(seq, ctypes.c_int32),
+        p(ts, ctypes.c_int64), p(mbit, ctypes.c_uint8),
+        p(rnd, ctypes.c_int32), p(wit, ctypes.c_uint8),
+        p(rr, ctypes.c_int32), p(cts, ctypes.c_int64),
+        p(fame, ctypes.c_int8),
+    )
+    if ordered < 0:
+        return None
+    return int(ordered), {
+        "round": rnd, "witness": wit.astype(bool), "rr": rr,
+        "cts": cts, "fame": fame,
+    }

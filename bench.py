@@ -1,18 +1,24 @@
 """Headline benchmark: consensus events/sec to full order on one chip.
 
-Workload: a 64-participant / 65536-event random-gossip DAG (the shape
-babble's TestGossip produces live, reference node/node_test.go:405-450)
-pushed through the whole device pipeline — coordinate ingest, round
-division, fame voting, order + timestamps — as one jitted step.  The host
-side is array-native (C++ graph builder, babble_tpu/native) so the
-measurement isolates the consensus engine.  Reported value is events
-brought to consensus order per second of device wall time (median of
-repeats, post-compile).
+Configs (BASELINE.md target list):
+- 64 x 65,536   — the shape babble's TestGossip produces live
+                  (reference node/node_test.go:405-450)
+- 1024 x 100,000 — the BASELINE.md large honest-DAG config (headline)
 
-Baseline: the reference's only published figure, 264.65 consensus events/s
-on its 4-node Docker testnet (reference README.md:154; see BASELINE.md).
+Each config runs the whole device pipeline — coordinate ingest, round
+division, fame voting, order + timestamps — as one jitted step (median of
+repeats, post-compile), and is compared against the **same-machine C++
+implementation of the reference algorithm** (native/baseline_consensus.cpp,
+differentially tested bit-identical to the TPU pipeline).  BASELINE.md's
+caveat requires exactly this: the published 264.65 ev/s figure is a 2017
+Docker-testnet wall-clock number dominated by 10 ms gossip heartbeats, not
+consensus compute, so the honest denominator is the reference *algorithm*
+re-measured on this machine (scaled BenchmarkFindOrder analogue; C++ stands
+in for Go — no Go toolchain in this image — with the constant factor
+favoring the baseline).
 
-Prints exactly one JSON line on stdout.
+Prints exactly one JSON line on stdout (the headline config); per-config
+detail goes to stderr.
 """
 
 from __future__ import annotations
@@ -22,11 +28,11 @@ import json
 import sys
 import time
 
-BASELINE_EVENTS_PER_SEC = 264.65
-
-N = 64
-E = 65536
-R_CAP = 512
+CONFIGS = [
+    # (n, events, s_cap_min, r_cap, headline)
+    (64, 65536, 64, 512, False),
+    (1024, 100_000, 64, 16, True),
+]
 REPEATS = 3
 
 
@@ -34,55 +40,87 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def run_config(n, e, s_cap_min, r_cap):
     import jax
     import numpy as np
 
-    from babble_tpu import native
+    from babble_tpu.native import baseline_consensus
     from babble_tpu.ops.state import DagConfig, init_state
     from babble_tpu.parallel.sharded import consensus_step_impl
     from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
 
-    log(f"devices: {jax.devices()}")
     t0 = time.perf_counter()
-    dag = random_gossip_arrays(N, E, seed=7)
+    dag = random_gossip_arrays(n, e, seed=7)
     batch = batch_from_arrays(dag)
     cfg = DagConfig(
-        n=N, e_cap=E, s_cap=max(64, dag.max_chain + 1), r_cap=R_CAP
+        n=n, e_cap=e, s_cap=max(s_cap_min, dag.max_chain + 1), r_cap=r_cap
     )
-    log(f"host build (native={native.available()}): "
-        f"{time.perf_counter()-t0:.2f}s; {dag.n_levels} levels; cfg {cfg}")
+    log(f"[{n}x{e}] host build: {time.perf_counter()-t0:.2f}s; "
+        f"{dag.n_levels} levels; cfg {cfg}")
+
+    # same-machine reference-algorithm baseline (C++); warm the g++ compile
+    # and dlopen outside the timed region
+    from babble_tpu.native import load_baseline
+
+    load_baseline()
+    t0 = time.perf_counter()
+    base = baseline_consensus(dag)
+    base_t = time.perf_counter() - t0
+    if base is None:
+        log(f"[{n}x{e}] WARNING: no C++ toolchain — baseline unavailable")
+        base_ordered, base_eps = 0, None
+    else:
+        base_ordered = base[0]
+        base_eps = base_ordered / base_t
+        log(f"[{n}x{e}] C++ reference baseline: {base_t:.3f}s, "
+            f"{base_ordered} ordered -> {base_eps:,.0f} ev/s")
 
     step = jax.jit(functools.partial(consensus_step_impl, cfg, "fast"))
-
     t0 = time.perf_counter()
     out = step(init_state(cfg), batch)
-    jax.block_until_ready(out)
-    log(f"compile + first run: {time.perf_counter()-t0:.1f}s")
-    ordered = int(np.count_nonzero(np.asarray(out.rr)[:E] >= 0))
+    _ = np.asarray(out.cts[:1])   # hard sync (tunneled backends)
+    log(f"[{n}x{e}] compile + first run: {time.perf_counter()-t0:.1f}s")
+
+    ordered = int(np.count_nonzero(np.asarray(out.rr)[:e] >= 0))
     lcr = int(out.lcr)
-    log(f"ordered {ordered}/{E} events, last consensus round {lcr}, "
+    log(f"[{n}x{e}] ordered {ordered}/{e}, last consensus round {lcr}, "
         f"max round {int(out.max_round)}")
     assert ordered > 0, "benchmark DAG reached no consensus"
     assert int(out.max_round) < cfg.r_cap - 1, "round capacity saturated"
+    if base is not None:
+        assert ordered == base_ordered, (
+            f"TPU/baseline ordered-count mismatch: {ordered} vs {base_ordered}"
+        )
 
     times = []
     for _ in range(REPEATS):
         s0 = init_state(cfg)
-        jax.block_until_ready(s0)
+        jax.block_until_ready(s0)     # ALL init arrays, not just one
+        _ = np.asarray(s0.la[:1])     # belt-and-braces on tunneled backends
         t0 = time.perf_counter()
         out = step(s0, batch)
-        jax.block_until_ready(out)
+        _ = np.asarray(out.cts[:1])
         times.append(time.perf_counter() - t0)
     t = sorted(times)[len(times) // 2]
-    log(f"times: {[f'{x:.3f}' for x in times]}")
+    eps = ordered / t
+    vs = (eps / base_eps) if base_eps else None
+    log(f"[{n}x{e}] times: {[f'{x:.3f}' for x in times]} -> {eps:,.0f} ev/s"
+        + (f" = {vs:.2f}x reference" if vs else ""))
+    return eps, vs
 
-    events_per_sec = ordered / t
+
+def main() -> None:
+    headline = None
+    for n, e, s_min, r_cap, is_headline in CONFIGS:
+        eps, vs = run_config(n, e, s_min, r_cap)
+        if is_headline:
+            headline = (eps, vs)
+    eps, vs = headline
     print(json.dumps({
-        "metric": "consensus_events_per_sec",
-        "value": round(events_per_sec, 2),
+        "metric": "consensus_events_per_sec_1024x100k",
+        "value": round(eps, 2),
         "unit": "events/s",
-        "vs_baseline": round(events_per_sec / BASELINE_EVENTS_PER_SEC, 2),
+        "vs_baseline": round(vs, 2) if vs else None,
     }))
 
 

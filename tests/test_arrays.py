@@ -122,3 +122,45 @@ def test_array_path_matches_engine_path():
     )
     ordered = int(np.count_nonzero(np.asarray(out.rr)[:e] >= 0))
     assert ordered > 0
+
+
+@pytest.mark.parametrize("n,e,seed", [(4, 200, 0), (8, 500, 3), (16, 1500, 9)])
+def test_cpp_baseline_matches_tpu_engine(n, e, seed):
+    """The C++ reference-algorithm baseline (bench denominator) must agree
+    with the TPU pipeline on rounds, witnesses, round-received, consensus
+    timestamps, and witness fame."""
+    import functools
+
+    import jax
+
+    from babble_tpu.native import baseline_consensus
+    from babble_tpu.ops.state import DagConfig, init_state
+    from babble_tpu.parallel.sharded import consensus_step_impl
+
+    dag = random_gossip_arrays(n, e, seed=seed)
+    res = baseline_consensus(dag)
+    assert res is not None, "toolchain is baked into the image"
+    ordered, base = res
+    assert ordered > 0
+
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 1, r_cap=64)
+    out = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))(
+        init_state(cfg), batch_from_arrays(dag)
+    )
+    np.testing.assert_array_equal(base["round"], np.asarray(out.round)[:e])
+    np.testing.assert_array_equal(base["witness"], np.asarray(out.witness)[:e])
+    np.testing.assert_array_equal(base["rr"], np.asarray(out.rr)[:e])
+    recv = base["rr"] >= 0
+    np.testing.assert_array_equal(
+        base["cts"][recv], np.asarray(out.cts)[:e][recv]
+    )
+    assert int(recv.sum()) == ordered
+
+    # fame trileans: engine's [R, N] wslot/famous table vs per-event fame
+    wslot = np.asarray(out.wslot)
+    famous = np.asarray(out.famous)
+    for r in range(wslot.shape[0]):
+        for j in range(n):
+            s = int(wslot[r, j])
+            if 0 <= s < e:
+                assert base["fame"][s] == famous[r, j], (r, j, s)
