@@ -84,7 +84,8 @@ def decide_fame_impl(cfg: DagConfig, state: DagState) -> DagState:
     tot_pad = jnp.concatenate([tot_next, jnp.zeros((R, n), F32)], axis=0)
     mb_pad = jnp.concatenate([mbw, jnp.zeros((R, n), bool)], axis=0)
 
-    i_idx = jnp.arange(R, dtype=I32)
+    # table row i holds absolute round i + r_off (rolling round window)
+    i_idx = jnp.arange(R, dtype=I32) + state.r_off
     in_window = (i_idx > state.lcr) & (i_idx < state.max_round)
 
     def step(d, carry):

@@ -96,7 +96,9 @@ def _write_batch_fields(state: DagState, cfg: DagConfig, b: EventBatch) -> DagSt
     real = pos < b.k
     slots = jnp.where(real, state.n_events + pos, cfg.e_cap)
     c_dump = jnp.where(real, b.creator, cfg.n)
-    s_dump = jnp.where(real, b.seq, cfg.s_cap)
+    # ce columns are seq-window-local (state docstring): col = seq - s_off[c]
+    s_loc = b.seq - state.s_off[jnp.clip(b.creator, 0, cfg.n)]
+    s_dump = jnp.where(real, s_loc, cfg.s_cap)
     return state._replace(
         sp=state.sp.at[slots].set(b.sp),
         op=state.op.at[slots].set(b.op),
@@ -182,18 +184,21 @@ def _fd_full(state: DagState, cfg: DagConfig) -> DagState:
     computed in t-chunks so the [N, S+1, N, Tc] broadcast never exceeds a
     few hundred MB."""
     n, s_cap = cfg.n, cfg.s_cap
-    cnt = state.cnt[:n]                                          # [N]
+    s_off = state.s_off[:n]                                      # [N]
+    cnt_w = state.cnt[:n] - s_off                                # windowed lengths
     cej = state.ce[:n]                                           # [N, S+1]
     s_idx = jnp.arange(s_cap + 1)
 
     # V[j, s, c] = la[chain_j[s], c], +INF past the chain tail so each
-    # (j, c) column stays sorted along s
+    # (j, c) column stays sorted along s.  s is a window-local position;
+    # la values stay absolute seqs.
     V = state.la[sanitize(cej, cfg.e_cap)]                       # [N, S+1, N]
     V = jnp.where(
-        (s_idx[None, :] < cnt[:, None])[:, :, None], V, INT32_MAX
+        (s_idx[None, :] < cnt_w[:, None])[:, :, None], V, INT32_MAX
     )
 
-    # out[j, c, t] = |{s : V[j, s, c] < t}|, reduced in chunks of t
+    # out[j, c, t] = |{s : V[j, s, c] < seq(c's event at window pos t)}|,
+    # reduced in chunks of t; the threshold is the absolute seq t + s_off[c]
     t_total = s_cap + 1
     # budget ~256 MB for the [N, S+1, N, Tc] broadcast in case XLA
     # materializes it rather than fusing into the reduction
@@ -203,18 +208,20 @@ def _fd_full(state: DagState, cfg: DagConfig) -> DagState:
 
     def count_chunk(t0):
         t_idx = t0 + jnp.arange(chunk)                           # [Tc]
-        lt = V[:, :, :, None] < t_idx[None, None, None, :]       # [N,S+1,N,Tc]
+        thr = t_idx[None, None, None, :] + s_off[None, None, :, None]
+        lt = V[:, :, :, None] < thr                              # [N,S+1,N,Tc]
         return lt.sum(axis=1, dtype=I32)                         # [N, N, Tc]
 
     counts = jax.lax.map(count_chunk, jnp.arange(n_chunks) * chunk)
     out = jnp.moveaxis(counts, 0, 2).reshape(n, n, tpad)[:, :, :t_total]
-    found = out < cnt[:, None, None]
-    out = jnp.where(found, out, INT32_MAX)                       # [N(j), N(c), T]
+    found = out < cnt_w[:, None, None]
+    # fd values are absolute seqs: window-local count + chain j's offset
+    out = jnp.where(found, out + s_off[:, None, None], INT32_MAX)
 
     # scatter back to event rows: fd[ce[c, t], j] = out[j, c, t]
     out_ctj = out.transpose(1, 2, 0)                             # [N(c), T, N(j)]
     tgt = jnp.where(
-        s_idx[None, :] < cnt[:, None], cej, cfg.e_cap
+        s_idx[None, :] < cnt_w[:, None], cej, cfg.e_cap
     )                                                            # [N, S+1]
     fd_new = state.fd.at[tgt].set(out_ctj)
     e_row = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
@@ -243,7 +250,10 @@ def _rounds_level_scan(
         pr = jnp.maximum(rnd[spx], rnd[opx])
         pr = jnp.where(is_root, 0, pr)
 
-        wsl = wslot[jnp.clip(pr, 0, cfg.r_cap)]                   # [B, N]
+        # parent rounds below the rolled window gather the sentinel row
+        # (those rounds are decided; see the w_row comment below)
+        pr_loc = jnp.where(pr >= state.r_off, pr - state.r_off, cfg.r_cap)
+        wsl = wslot[jnp.clip(pr_loc, 0, cfg.r_cap)]               # [B, N]
         fdw = state.fd[sanitize(wsl, cfg.e_cap)]                  # [B, N, N]
         la_x = state.la[idx]                                      # [B, N]
         ss_cnt = (la_x[:, None, :] >= fdw).sum(-1)                # [B, N]
@@ -254,7 +264,14 @@ def _rounds_level_scan(
 
         rnd = rnd.at[idx].set(jnp.where(real, r_x, -1))
         wit = wit.at[idx].set(w_x & real)
-        w_row = jnp.where(w_x & real, r_x, cfg.r_cap)
+        # r_x < r_off can only happen for pathological laggard events whose
+        # parents both sit below the rolled round window; those rounds are
+        # long decided, so (like the reference's pendingRounds pop) a late
+        # witness there is never voted on — dump the write, never let the
+        # negative index clamp into row 0.
+        w_row = jnp.where(
+            w_x & real & (r_x >= state.r_off), r_x - state.r_off, cfg.r_cap
+        )
         w_col = jnp.clip(state.creator[idx], 0, n - 1)
         wslot = wslot.at[w_row, w_col].set(idx)
         max_round = jnp.maximum(max_round, jnp.max(jnp.where(real, r_x, -1)))
@@ -313,12 +330,18 @@ def _la_absorb(state: DagState, cfg: DagConfig) -> DagState:
     spx = sanitize(state.sp, cfg.e_cap)
     opx = sanitize(state.op, cfg.e_cap)
 
+    s_off = state.s_off[:n]
+
     def absorb(la):
         # Cross-chain: absorb the rows of the frontier events (the deepest
         # event seen per chain).  The own-chain frontier is the event
         # itself, so the direct parents' rows are absorbed explicitly —
-        # that's what propagates knowledge down the self-chain.
-        fr = state.ce[cols[None, :], jnp.where(la >= 0, la, s_cap)]
+        # that's what propagates knowledge down the self-chain.  la values
+        # are absolute seqs; ce columns are window-local (frontier events
+        # below a rolled window gather the sentinel and contribute nothing
+        # — their knowledge is already in the converged parent rows).
+        wi = la - s_off[None, :]
+        fr = state.ce[cols[None, :], jnp.where((la >= 0) & (wi >= 0), wi, s_cap)]
         absorbed = la[sanitize(fr, cfg.e_cap)]            # [E+1, N, N]
         out = jnp.maximum(la, absorbed.max(axis=1))
         return jnp.maximum(out, jnp.maximum(la[spx], la[opx]))
@@ -352,9 +375,16 @@ def _rounds_frontier(state: DagState, cfg: DagConfig) -> DagState:
     strongly sees a jumped candidate also descends from the candidate's
     round>r ancestor and is therefore in the >=r+1 region regardless.
     Exact witness tables are derived from pos afterwards, so fame voting
-    only ever sees true round-r witnesses."""
+    only ever sees true round-r witnesses.
+
+    Window note: the march starts from each chain's window base and round
+    r_off, so it is only exact when the window base IS the round-r_off
+    witness frontier — true for fresh states (all offsets zero), which is
+    the only way the engine reaches this path ('fast'/'absorb' batch
+    modes).  The live rolled-window path uses the incremental level scan."""
     n, sm, s_cap, r_cap = cfg.n, cfg.super_majority, cfg.s_cap, cfg.r_cap
-    cnt = state.cnt[:n]                                    # i32[N]
+    s_off = state.s_off[:n]
+    cnt = state.cnt[:n] - s_off                            # windowed lengths
     cej = state.ce[:n]                                     # [N, S+1]
     rows = jnp.arange(n)
     bisect_iters = max(1, (s_cap + 1).bit_length())
@@ -385,9 +415,13 @@ def _rounds_frontier(state: DagState, cfg: DagConfig) -> DagState:
         found = s_star < cnt
 
         # descent inheritance: fd rows of the per-chain first inc events
+        # (fd values are absolute seqs -> window-local positions)
         e_star = cej[rows, jnp.clip(s_star, 0, s_cap)]
         fde = state.fd[sanitize(jnp.where(found, e_star, -1), cfg.e_cap)]
-        inherit = fde.min(axis=0)                          # [N]
+        inherit = fde.min(axis=0)                          # [N] absolute
+        inherit = jnp.where(
+            inherit == INT32_MAX, INT32_MAX, inherit - s_off
+        )
         pos_next = jnp.minimum(
             jnp.where(found, s_star, INT32_MAX), inherit
         )
@@ -408,13 +442,14 @@ def _rounds_frontier(state: DagState, cfg: DagConfig) -> DagState:
     # per-event rounds from the pos table: round(x) = |{r : pos[r, c] <= seq}| - 1
     e1 = cfg.e_cap + 1
     c_x = jnp.clip(state.creator, 0, n - 1)
+    wseq = state.seq - state.s_off[c_x]                    # window-local seqs
     pos_c = pos_table[:, c_x]                              # [R+1, E+1]
-    rnd = (pos_c <= state.seq[None, :]).sum(0).astype(I32) - 1
+    rnd = (pos_c <= wseq[None, :]).sum(0).astype(I32) - 1 + state.r_off
     valid_e = (jnp.arange(e1) < state.n_events) & (state.seq >= 0)
     rnd = jnp.where(valid_e, rnd, -1)
 
     wit = valid_e & (
-        pos_table[jnp.clip(rnd, 0, r_cap), c_x] == state.seq
+        pos_table[jnp.clip(rnd - state.r_off, 0, r_cap), c_x] == wseq
     )
 
     # exact witness table: chain j's round-r witness exists iff the

@@ -49,23 +49,26 @@ def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
     und = valid_e & (state.rr == -1)
 
     def step(i, rr):
-        active = decided[i] & has_w[i] & (i <= state.max_round)
+        # table row i holds absolute round i_abs (rolling round window);
+        # i_abs >= 1 is implied by i_abs > round(x) >= 0 for valid events
+        i_abs = i + state.r_off
+        active = decided[i] & has_w[i] & (i_abs <= state.max_round)
         sees = fam[i][None, :] & (state.fd <= seqw[i][None, :])      # [E+1, N]
         c = sees.sum(axis=1)
         cond = (
             und
             & (rr == -1)
-            & (i > state.round)
+            & (i_abs > state.round)
             & active
             & (c > fam_cnt[i] // 2)
         )
-        return jnp.where(cond, i, rr)
+        return jnp.where(cond, i_abs, rr)
 
-    rr = jax.lax.fori_loop(1, R, step, state.rr)
+    rr = jax.lax.fori_loop(0, R, step, state.rr)
     newly = und & (rr != -1)
 
     # consensus timestamps for newly-received events
-    i_of = jnp.clip(rr, 0, R - 1)
+    i_of = jnp.clip(rr - state.r_off, 0, R - 1)
     fam_i = fam[i_of]                                      # [E+1, N]
     seqw_i = seqw[i_of]                                    # [E+1, N]
     sees_i = fam_i & (state.fd <= seqw_i)                  # [E+1, N]
@@ -78,7 +81,8 @@ def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
     # is pure vectorized VPU work.
     cej = state.ce[:n]                                     # [N, S+1]
     ts_grid = state.ts[sanitize(cej, cfg.e_cap)]           # i64[N, S+1]
-    fdc = jnp.clip(state.fd, 0, cfg.s_cap)                 # [E+1, N]
+    # fd values are absolute seqs; the grid columns are window-local
+    fdc = jnp.clip(state.fd - state.s_off[None, :n], 0, cfg.s_cap)
 
     def acc_step(s, acc):
         return jnp.where(fdc == s, ts_grid[:, s][None, :], acc)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -67,7 +68,21 @@ class DagConfig(NamedTuple):
 class DagState(NamedTuple):
     """Device arrays.  Every per-event array has e_cap+1 rows; every
     per-round array has r_cap+1 rows; ce has an (n+1)-th dump row — the last
-    row/col of each is the write-dump & gather-sentinel for padding."""
+    row/col of each is the write-dump & gather-sentinel for padding.
+
+    Rolling windows (bounded memory, reference caches.go:45-76 semantics):
+    the three unbounded logical axes are windowed by traced offsets so a
+    long-lived node's state stays a fixed shape with no recompilation:
+
+    - event axis: device row i holds the event at *global* slot
+      ``e_off + i``; ``compact`` shifts decided prefixes out.
+    - seq axis: ``ce[c, q]`` holds creator c's event at *absolute* seq
+      ``s_off[c] + q``.  Coordinate values in la/fd stay absolute seqs.
+    - round axis: ``wslot/famous[r]`` describe *absolute* round
+      ``r_off + r``.  ``round``/``rr``/``max_round``/``lcr`` stay absolute.
+
+    Offsets are all zero until ``compact`` runs, so fresh/batch pipelines
+    are unaffected."""
 
     # per-event
     sp: jnp.ndarray        # i32[E+1]   self-parent slot, -1 = none
@@ -92,9 +107,14 @@ class DagState(NamedTuple):
     famous: jnp.ndarray    # i8[R+1, N]     trilean
 
     # scalars
-    n_events: jnp.ndarray  # i32
+    n_events: jnp.ndarray  # i32  live (windowed) event count
     max_round: jnp.ndarray # i32  highest assigned round, -1 if none
     lcr: jnp.ndarray       # i32  last consensus round, -1 if none
+
+    # rolling-window offsets (see class docstring)
+    e_off: jnp.ndarray     # i32      global slot of device row 0
+    s_off: jnp.ndarray     # i32[N+1] absolute seq of ce column 0, per creator
+    r_off: jnp.ndarray     # i32      absolute round of wslot/famous row 0
 
 
 def init_state(cfg: DagConfig) -> DagState:
@@ -119,6 +139,9 @@ def init_state(cfg: DagConfig) -> DagState:
         n_events=jnp.zeros((), I32),
         max_round=jnp.full((), -1, I32),
         lcr=jnp.full((), -1, I32),
+        e_off=jnp.zeros((), I32),
+        s_off=jnp.zeros((n + 1,), I32),
+        r_off=jnp.zeros((), I32),
     )
 
 
@@ -150,7 +173,86 @@ def grow_state(state: DagState, old: DagConfig, new: DagConfig) -> DagState:
         n_events=state.n_events,
         max_round=state.max_round,
         lcr=state.lcr,
+        e_off=state.e_off,
+        s_off=fresh.s_off.at[: old.n + 1].set(state.s_off),
+        r_off=state.r_off,
     )
+
+
+def compact_impl(
+    cfg: DagConfig,
+    state: DagState,
+    de: jnp.ndarray,        # i32: event slots to evict (a decided prefix)
+    new_s_off: jnp.ndarray, # i32[N+1]: absolute seq of each creator's window start
+    dr: jnp.ndarray,        # i32: rounds to roll off the witness tables
+) -> DagState:
+    """Roll the windows: shift every axis down in place (fixed shapes, no
+    recompilation) — the device half of the reference's rolling caches
+    (caches.go:45-76).  The caller (engine.maybe_compact) guarantees the
+    evicted prefix is never referenced again: every evicted event is
+    committed, below every creator's seq window, and of a round below the
+    new r_off; chain slots ascend with seq, so kept seqs ↔ kept slots.
+
+    Shift trick: row e_cap of every per-event array holds the same values
+    as an untouched (init) row, so ``a[min(arange + de, e_cap)]`` both
+    shifts the live rows down and back-fills the tail with fresh init/
+    sentinel rows in one gather."""
+    e1, s1, r1 = cfg.e_cap + 1, cfg.s_cap + 1, cfg.r_cap + 1
+
+    eidx = jnp.minimum(jnp.arange(e1) + de, cfg.e_cap)
+    remap = lambda v: jnp.where(v >= de, v - de, -1)  # slot values -> local
+
+    # ce: per-creator column shift by (new_s_off - s_off), values remapped
+    ds = (new_s_off - state.s_off)[:, None]                       # [N+1, 1]
+    scol = jnp.minimum(jnp.arange(s1)[None, :] + ds, cfg.s_cap)
+    ce = remap(jnp.take_along_axis(state.ce, scol, axis=1))
+
+    ridx = jnp.minimum(jnp.arange(r1) + dr, cfg.r_cap)
+
+    return state._replace(
+        sp=remap(state.sp[eidx]),
+        op=remap(state.op[eidx]),
+        creator=state.creator[eidx],
+        seq=state.seq[eidx],
+        ts=state.ts[eidx],
+        mbit=state.mbit[eidx],
+        la=state.la[eidx],
+        fd=state.fd[eidx],
+        round=state.round[eidx],
+        witness=state.witness[eidx],
+        rr=state.rr[eidx],
+        cts=state.cts[eidx],
+        ce=ce,
+        wslot=remap(state.wslot[ridx]),
+        famous=state.famous[ridx],
+        n_events=state.n_events - de,
+        e_off=state.e_off + de,
+        s_off=new_s_off,
+        r_off=state.r_off + dr,
+    )
+
+
+compact = jax.jit(compact_impl, static_argnums=(0,), donate_argnums=(1,))
+
+
+def rebuild_wslot_impl(cfg: DagConfig, state: DagState) -> DagState:
+    """Recompute the creator-indexed witness table from the per-event
+    round/witness arrays (used after growing r_cap: earlier witness writes
+    at rounds >= the old capacity were clipped into the dump row)."""
+    e1 = cfg.e_cap + 1
+    valid = state.witness & (jnp.arange(e1) < state.n_events) & (state.seq >= 0)
+    r_loc = jnp.where(valid, state.round - state.r_off, cfg.r_cap)
+    r_loc = jnp.clip(r_loc, 0, cfg.r_cap)
+    wslot = jnp.full((cfg.r_cap + 1, cfg.n), -1, I32)
+    wslot = wslot.at[r_loc, jnp.clip(state.creator, 0, cfg.n - 1)].set(
+        jnp.where(valid, jnp.arange(e1, dtype=I32), -1).astype(I32)
+    )
+    # dump-row writes (invalid lanes) all landed in row r_cap; restore it
+    r_row = (jnp.arange(cfg.r_cap + 1) == cfg.r_cap)[:, None]
+    return state._replace(wslot=set_sentinel(wslot, r_row, -1))
+
+
+rebuild_wslot = jax.jit(rebuild_wslot_impl, static_argnums=(0,), donate_argnums=(1,))
 
 
 def sanitize(idx: jnp.ndarray, sentinel: int) -> jnp.ndarray:

@@ -48,6 +48,7 @@ def state_specs() -> DagState:
         ce=P(), cnt=P(),
         wslot=P(None, "p"), famous=P(None, "p"),
         n_events=P(), max_round=P(), lcr=P(),
+        e_off=P(), s_off=P(), r_off=P(),
     )
 
 
