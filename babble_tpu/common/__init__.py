@@ -6,6 +6,9 @@ These serve the host runtime only; device state lives in dense arrays
 
 from .errors import KeyNotFoundError, TooLateError
 from .lru import LRU
+from .offset_list import OffsetList
 from .rolling_list import RollingList
 
-__all__ = ["LRU", "RollingList", "KeyNotFoundError", "TooLateError"]
+__all__ = [
+    "LRU", "OffsetList", "RollingList", "KeyNotFoundError", "TooLateError",
+]

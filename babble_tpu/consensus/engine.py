@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import OffsetList
 from ..core.dag import HostDag, InsertError
 from ..core.event import Event, WireEvent
 from ..ops import fame as fame_ops
@@ -38,6 +39,7 @@ from ..ops.state import (
     INT32_MAX,
     DagConfig,
     DagState,
+    compact as compact_op,
     grow_state,
     init_state,
 )
@@ -59,6 +61,11 @@ class TpuHashgraph:
         e_cap: int = 4096,
         s_cap: int = 1024,
         r_cap: int = 64,
+        auto_compact: bool = False,
+        seq_window: int = 256,
+        round_margin: int = 2,
+        compact_min: Optional[int] = None,
+        consensus_window: Optional[int] = None,
     ):
         n = len(participants)
         self.participants = participants
@@ -67,12 +74,29 @@ class TpuHashgraph:
         self.cfg = DagConfig(n=n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap)
         self.state: DagState = init_state(self.cfg)
 
-        self.consensus: List[str] = []            # hex ids in consensus order
+        # Rolling-window policy (reference caches.go semantics; the live
+        # node turns auto_compact on so memory stays bounded forever):
+        # - seq_window: newest events per creator always kept (other-parent
+        #   reachability for lagging peers; beyond it syncs get TooLate)
+        # - round_margin: decided rounds kept below lcr (committer safety)
+        # - compact_min: evictable-prefix length worth a compaction pass
+        # - consensus_window: committed-log entries kept (None = all)
+        self.auto_compact = auto_compact
+        self.seq_window = seq_window
+        self.round_margin = round_margin
+        self.compact_min = compact_min if compact_min is not None else max(
+            e_cap // 4, 32
+        )
+        self.consensus_window = consensus_window
+
+        self.consensus = OffsetList()             # hex ids in consensus order
         self.consensus_transactions = 0
         self.last_committed_round_events = 0
-        self._received: set = set()               # slots already ordered
+        self._received: set = set()               # global slots already ordered
+        self._ordered_total = 0                   # |_received| incl. evicted
         self._view: Dict[str, np.ndarray] = {}    # host cache of device arrays
         self._lcr_cache = -1                      # host mirror for lock-free stats
+        self._r_off = 0                           # host mirror of state.r_off
 
     # ------------------------------------------------------------------
     # properties mirroring the oracle/reference
@@ -94,7 +118,7 @@ class TpuHashgraph:
     @property
     def undetermined_count(self) -> int:
         self.flush()
-        return self.dag.n_events - len(self._received)
+        return self.dag.n_events - self._ordered_total
 
     def stats_snapshot(self) -> Dict[str, int]:
         """Lock-free stats from host-side mirrors — safe to call from the
@@ -102,10 +126,13 @@ class TpuHashgraph:
         (no flush, no device reads)."""
         return {
             "last_consensus_round": self._lcr_cache,
-            "undetermined_events": self.dag.n_events - len(self._received),
+            "undetermined_events": self.dag.n_events - self._ordered_total,
             "consensus_events": len(self.consensus),
             "consensus_transactions": self.consensus_transactions,
             "last_committed_round_events": self.last_committed_round_events,
+            # rolling-window gauges: total history vs what's actually held
+            "evicted_events": self.dag.slot_base,
+            "live_window": self.dag.n_events - self.dag.slot_base,
         }
 
     # ------------------------------------------------------------------
@@ -123,28 +150,56 @@ class TpuHashgraph:
         self._view = {}
         # Round-capacity saturation check: if the highest assigned round is
         # at the capacity edge, witness-table writes may have clipped and
-        # round assignment stalled — grow and recompute from host truth.
-        if int(self.state.max_round) >= self.cfg.r_cap - 1:
-            self._rebuild(r_cap=self.cfg.r_cap * 2)
+        # round increments may have been missed — grow the window and
+        # recompute the suspect suffix (no full re-ingest: coordinates are
+        # round-independent, and evicted history could not be replayed).
+        if int(self.state.max_round) - self._r_off >= self.cfg.r_cap - 1:
+            self._repair_rounds()
 
-    def _rebuild(self, r_cap: int) -> None:
-        """Re-ingest the full host DAG into a fresh state with a larger
-        round capacity.  Fame/order decisions are recomputed on the next
-        pipeline call — they are deterministic, and `_received` keeps
-        already-committed events from being emitted twice."""
-        while r_cap <= int(self.state.max_round) + 1:
-            r_cap *= 2
-        self.cfg = DagConfig(
-            n=self.cfg.n, e_cap=self.cfg.e_cap, s_cap=self.cfg.s_cap,
-            r_cap=r_cap, n_real=self.cfg.n_real,
-        )
-        self.state = init_state(self.cfg)
-        self.dag.pending = list(range(self.dag.n_events))
-        batch, _ = self.build_batch()
-        self.state = ingest_ops.ingest(self.cfg, self.state, "full", batch)
-        self._view = {}
-        if int(self.state.max_round) >= self.cfg.r_cap - 1:  # still clipped
-            self._rebuild(r_cap=self.cfg.r_cap * 2)
+    def _repair_rounds(self) -> None:
+        """Double r_cap and recompute rounds for events whose assignment may
+        have clipped.  An event's stored round can only be wrong if a parent
+        round hit the witness-table edge, so the suspect set is exactly
+        ``round >= r_off + old_r_cap`` (descendants of a wrong event always
+        carry a stored round >= their wrong parent's, keeping the set
+        closed).  Suspects are rescanned level by level against the intact
+        lower witness rows."""
+        base = self.dag.slot_base
+        while True:
+            old_r_cap = self.cfg.r_cap
+            new_cfg = DagConfig(
+                n=self.cfg.n, e_cap=self.cfg.e_cap, s_cap=self.cfg.s_cap,
+                r_cap=old_r_cap * 2, n_real=self.cfg.n_real,
+            )
+            self.state = grow_state(self.state, self.cfg, new_cfg)
+            self.cfg = new_cfg
+            self._view = {}
+
+            rnd = self._arr("round")
+            ne = self.dag.n_events - base
+            sus = np.nonzero(
+                rnd[:ne] >= self._r_off + old_r_cap
+            )[0].astype(np.int32)
+            if len(sus):
+                lev = np.array(
+                    [self.dag.levels[base + int(s)] for s in sus], np.int64
+                )
+                order = np.argsort(lev, kind="stable")
+                ulev, starts = np.unique(lev[order], return_index=True)
+                bounds = list(starts) + [len(sus)]
+                t = len(ulev)
+                b = max(int(np.max(np.diff(bounds))), 1)
+                tpad, bpad = _bucket(t, 1), _bucket(b, 1)
+                slot_sched = np.full((tpad, bpad), -1, np.int32)
+                for row in range(t):
+                    grp = sus[order[bounds[row] : bounds[row + 1]]]
+                    slot_sched[row, : len(grp)] = grp
+                self.state = ingest_ops.rescan_rounds(
+                    self.cfg, self.state, jnp.asarray(slot_sched)
+                )
+                self._view = {}
+            if int(self.state.max_round) - self._r_off < self.cfg.r_cap - 1:
+                return
 
     def build_batch(self):
         """Drain pending host events into a padded device EventBatch.
@@ -184,15 +239,18 @@ class TpuHashgraph:
 
     def _ensure_capacity(self, k_new: int) -> None:
         cfg = self.cfg
-        need_e = self.dag.n_events  # host already includes pending
-        max_chain = max((len(c) for c in self.dag.chains), default=0)
+        # live (windowed) extents — capacities bound the window, not history
+        need_e = self.dag.n_events - self.dag.slot_base
+        max_chain = max(
+            (len(c) - c.start for c in self.dag.chains), default=0
+        )
         # Rounds heuristic: a level can raise the max round by at most 1,
         # but in practice a round spans several levels, so sizing r_cap by
         # level count would inflate the fame/order tensors ~4x.  Undershoot
-        # is safe: flush() detects wslot saturation and rebuilds.
+        # is safe: flush() detects wslot saturation and repairs.
         levels_new = len({self.dag.levels[s] for s in self.dag.pending})
         need_r = (
-            max(int(self.state.max_round), 0)
+            max(int(self.state.max_round) - self._r_off, 0)
             + 2
             + min(levels_new, max(8, levels_new // 4))
         )
@@ -232,21 +290,26 @@ class TpuHashgraph:
 
         rr = self._arr("rr")
         cts = self._arr("cts")
-        ne = self.dag.n_events
+        base = self.dag.slot_base
+        ne = self.dag.n_events - base          # live rows
         self._lcr_cache = int(self.state.lcr)
         new_slots = [
-            s for s in range(ne) if rr[s] >= 0 and s not in self._received
+            s for s in range(ne)
+            if rr[s] >= 0 and (base + s) not in self._received
         ]
         if not new_slots:
+            if self.auto_compact:
+                self.maybe_compact()
             return []
 
         new_events: List[Event] = []
         for s in new_slots:
-            ev = self.dag.events[s]
+            ev = self.dag.events[base + s]
             ev.round_received = int(rr[s])
             ev.consensus_timestamp = int(cts[s])
             new_events.append(ev)
-            self._received.add(s)
+            self._received.add(base + s)
+        self._ordered_total += len(new_slots)
 
         from .ordering import consensus_sort
 
@@ -265,6 +328,8 @@ class TpuHashgraph:
 
         if self.commit_callback is not None and new_events:
             self.commit_callback(new_events)
+        if self.auto_compact:
+            self.maybe_compact()
         return new_events
 
     def run_consensus(self) -> List[Event]:
@@ -272,17 +337,84 @@ class TpuHashgraph:
         self.decide_fame()
         return self.find_order()
 
+    # ------------------------------------------------------------------
+    # rolling-window compaction (reference caches.go:45-76 applied to the
+    # dense device state; see ops/state.py compact_impl)
+
+    def maybe_compact(self, force: bool = False) -> int:
+        """Evict the longest committed prefix that nothing can reference
+        again, and roll the round window up to ``lcr - round_margin``.
+
+        A slot is evictable when (a) it is ordered/committed, (b) its round
+        is below the new round-window base (so no witness-table row can
+        point at it), and (c) it sits ``seq_window`` seqs behind its
+        creator's head (so no incoming event can name it as a parent —
+        beyond that, syncs get TooLateError, the reference's rolling-cache
+        contract).  Chain slots ascend with seq, so the per-creator seq
+        windows and the slot prefix stay consistent by construction.
+
+        Returns the number of evicted slots.  No-ops while host events are
+        pending (their parents must stay resolvable until flushed)."""
+        if self.dag.pending:
+            return 0
+        lcr = int(self.state.lcr)
+        new_r_off = lcr - self.round_margin
+        if new_r_off <= 0:
+            return 0
+        base = self.dag.slot_base
+        ne = self.dag.n_events - base
+        dr = max(0, new_r_off - self._r_off)
+
+        rr = self._arr("rr")[:ne]
+        rnd = self._arr("round")[:ne]
+        seq = self._arr("seq")[:ne]
+        creator = self._arr("creator")[:ne]
+        counts = np.fromiter(
+            (len(c) for c in self.dag.chains), np.int64, self.n
+        )
+        ok = (
+            (rr >= 0)
+            & (rnd < new_r_off)
+            & (seq < counts[creator] - self.seq_window)
+        )
+        k = int(np.argmin(ok)) if not ok.all() else ne
+        if (k < self.compact_min and not force) or (k == 0 and dr == 0):
+            return 0
+
+        # host first: chain starts after eviction define the seq windows
+        self.dag.evict_prefix(base + k)
+        new_s_off = np.zeros(self.n + 1, np.int32)
+        new_s_off[: self.n] = [c.start for c in self.dag.chains]
+        self.state = compact_op(
+            self.cfg, self.state,
+            jnp.asarray(k, jnp.int32), jnp.asarray(new_s_off),
+            jnp.asarray(dr, jnp.int32),
+        )
+        self._received = {g for g in self._received if g >= base + k}
+        self._r_off += dr
+        self._view = {}
+        if self.consensus_window is not None:
+            self.consensus.evict_to(
+                max(self.consensus.start,
+                    len(self.consensus) - self.consensus_window)
+            )
+        return k
+
     def _round_prn(self, r: int) -> int:
         """Whitening seed: XOR of the round's famous-witness hashes
         (reference roundInfo.go:109-118)."""
-        if r < 0 or r >= self.cfg.r_cap:
+        r_loc = r - self._r_off
+        if r_loc < 0 or r_loc >= self.cfg.r_cap:
             return 0
         wslot = self._arr("wslot")
         famous = self._arr("famous")
+        base = self.dag.slot_base
         res = 0
         for j in range(self.n):
-            if wslot[r, j] >= 0 and famous[r, j] == FAME_TRUE:
-                res ^= int(self.dag.events[int(wslot[r, j])].hex(), 16)
+            if wslot[r_loc, j] >= 0 and famous[r_loc, j] == FAME_TRUE:
+                res ^= int(
+                    self.dag.events[base + int(wslot[r_loc, j])].hex(), 16
+                )
         return res
 
     # ------------------------------------------------------------------
@@ -303,10 +435,14 @@ class TpuHashgraph:
         return self._view[name]
 
     def _slot(self, x: str) -> int:
+        """Device-local row of event hex x (KeyError if unknown/evicted)."""
         s = self.dag.slot_of.get(x, -1)
         if s < 0:
             raise KeyError(x)
-        return s
+        return s - self.dag.slot_base
+
+    def _event_at(self, local_slot: int) -> Event:
+        return self.dag.events[self.dag.slot_base + local_slot]
 
     def ancestor(self, x: str, y: str) -> bool:
         if x == "" or y == "":
@@ -319,8 +455,9 @@ class TpuHashgraph:
         except KeyError:
             return False
         la = self._arr("la")
-        cy = self.participants[self.dag.events[sy].creator]
-        return bool(la[sx, cy] >= self.dag.events[sy].index)
+        ey = self._event_at(sy)
+        cy = self.participants[ey.creator]
+        return bool(la[sx, cy] >= ey.index)
 
     def see(self, x: str, y: str) -> bool:
         return self.ancestor(x, y)
@@ -331,8 +468,8 @@ class TpuHashgraph:
         if x == y:
             return True
         try:
-            ex = self.dag.events[self._slot(x)]
-            ey = self.dag.events[self._slot(y)]
+            ex = self._event_at(self._slot(x))
+            ey = self._event_at(self._slot(y))
         except KeyError:
             return False
         return ex.creator == ey.creator and ex.index >= ey.index
@@ -353,7 +490,7 @@ class TpuHashgraph:
         except KeyError:
             return ""
         fd = self._arr("fd")
-        ex = self.dag.events[sx]
+        ex = self._event_at(sx)
         j = self.participants[ex.creator]
         f = int(fd[sy, j])
         if f <= ex.index and f != int(INT32_MAX):
@@ -371,23 +508,25 @@ class TpuHashgraph:
     def round_witnesses(self, r: int) -> List[str]:
         self.flush()
         wslot = self._arr("wslot")
-        if r < 0 or r >= self.cfg.r_cap:
+        r_loc = r - self._r_off
+        if r_loc < 0 or r_loc >= self.cfg.r_cap:
             return []
         return [
-            self.dag.events[int(s)].hex() for s in wslot[r] if s >= 0
+            self._event_at(int(s)).hex() for s in wslot[r_loc] if s >= 0
         ]
 
     def famous_of(self, r: int, x: str) -> Optional[bool]:
         """Fame trilean of witness x in round r (None = undecided)."""
         self.flush()
-        if r < 0 or r >= self.cfg.r_cap:
+        r_loc = r - self._r_off
+        if r_loc < 0 or r_loc >= self.cfg.r_cap:
             return None
         wslot = self._arr("wslot")
         famous = self._arr("famous")
         sx = self._slot(x)
         for j in range(self.n):
-            if wslot[r, j] == sx:
-                f = famous[r, j]
+            if wslot[r_loc, j] == sx:
+                f = famous[r_loc, j]
                 return None if f == FAME_UNDEFINED else bool(f == FAME_TRUE)
         return None
 

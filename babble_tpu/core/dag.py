@@ -13,15 +13,25 @@ the implicit fork rejection.
 Levels: level(x) = 1 + max(level(sp), level(op)), 0 for roots.  Events of one
 level are mutually non-ancestral, which is what lets the device kernels
 process a level per step (see ops/ingest.py).
+
+Bounded memory: every per-slot sequence is an ``OffsetList`` — indices are
+absolute forever, but committed prefixes can be evicted (``evict_prefix``,
+driven by the engine's compaction in lockstep with the device window).
+Reads below the window raise ``TooLateError``, the reference's rolling-cache
+semantics (caches.go:45-76): a peer that has fallen behind the window gets
+the too-late error through the sync path instead of unbounded history.
+Wire parent coordinates are captured at insert (``wire_meta``) so ``to_wire``
+never needs an evicted parent object.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
+from ..common import OffsetList
 from ..crypto.keys import pub_hex_to_bytes
 from .event import Event, EventBody, WireEvent
 
@@ -36,17 +46,20 @@ class HostDag:
     verify_signatures: bool = True
 
     reverse_participants: Dict[int, str] = field(init=False)
-    events: List[Event] = field(default_factory=list)          # by slot
+    events: OffsetList = field(default_factory=OffsetList)     # by slot
     slot_of: Dict[str, int] = field(default_factory=dict)      # hex -> slot
-    levels: List[int] = field(default_factory=list)            # by slot
-    sp_slot: List[int] = field(default_factory=list)
-    op_slot: List[int] = field(default_factory=list)
-    chains: List[List[int]] = field(init=False)                # creator -> slots
+    levels: OffsetList = field(default_factory=OffsetList)     # by slot
+    sp_slot: OffsetList = field(default_factory=OffsetList)
+    op_slot: OffsetList = field(default_factory=OffsetList)
+    # (sp_index, op_creator_id, op_index) by slot — wire coords captured at
+    # insert so conversion survives parent eviction
+    wire_meta: OffsetList = field(default_factory=OffsetList)
+    chains: List[OffsetList] = field(init=False)               # creator -> slots
     pending: List[int] = field(default_factory=list)           # unflushed slots
 
     def __post_init__(self):
         self.reverse_participants = {v: k for k, v in self.participants.items()}
-        self.chains = [[] for _ in range(len(self.participants))]
+        self.chains = [OffsetList() for _ in range(len(self.participants))]
 
     @property
     def n(self) -> int:
@@ -54,7 +67,13 @@ class HostDag:
 
     @property
     def n_events(self) -> int:
+        """Total events ever inserted (next slot number)."""
         return len(self.events)
+
+    @property
+    def slot_base(self) -> int:
+        """First non-evicted slot (== the device state's e_off)."""
+        return self.events.start
 
     # ------------------------------------------------------------------
 
@@ -75,6 +94,7 @@ class HostDag:
                     f"root event must have index 0, got {event.index}"
                 )
             sps = ops = -1
+            meta = (-1, -1, -1)
         else:
             sps = self.slot_of.get(sp, -1)
             if sps < 0:
@@ -97,6 +117,12 @@ class HostDag:
                 raise InsertError(
                     f"bad sequence index {event.index}, expected {len(chain)}"
                 )
+            op_ev = self.events[ops]
+            meta = (
+                self.events[sps].index,
+                self.participants[op_ev.creator],
+                op_ev.index,
+            )
 
         hex_id = event.hex()
         if hex_id in self.slot_of:
@@ -115,20 +141,42 @@ class HostDag:
         self.levels.append(level)
         self.sp_slot.append(sps)
         self.op_slot.append(ops)
+        self.wire_meta.append(meta)
         chain.append(slot)
         self.pending.append(slot)
         return slot
 
     # ------------------------------------------------------------------
 
+    def evict_prefix(self, new_base: int) -> None:
+        """Drop every slot below ``new_base`` (the engine guarantees they are
+        committed and outside every rolling window — see maybe_compact)."""
+        for ev in self.events.evict_to(new_base):
+            del self.slot_of[ev.hex()]
+        self.levels.evict_to(new_base)
+        self.sp_slot.evict_to(new_base)
+        self.op_slot.evict_to(new_base)
+        self.wire_meta.evict_to(new_base)
+        for chain in self.chains:
+            w = chain.window
+            # chain slots ascend, so the evicted part is a prefix
+            k = 0
+            while k < len(w) and w[k] < new_base:
+                k += 1
+            chain.evict_to(chain.start + k)
+
+    # ------------------------------------------------------------------
+
     def take_pending(self) -> Tuple[np.ndarray, ...]:
         """Drain pending slots into batch arrays + a level-grouped schedule.
 
-        Returns (sp, op, creator, seq, ts, mbit, sched) as numpy arrays;
-        sched holds batch positions (0-based within this batch), -1 padding.
+        Returns (sp, op, creator, seq, ts, mbit, sched) as numpy arrays with
+        *device-local* parent slots (global - slot_base); sched holds batch
+        positions (0-based within this batch), -1 padding.
         """
         slots = self.pending
         self.pending = []
+        base = self.slot_base
         k = len(slots)
         sp = np.empty(k, np.int32)
         op = np.empty(k, np.int32)
@@ -139,8 +187,9 @@ class HostDag:
         lev = np.empty(k, np.int64)
         for i, s in enumerate(slots):
             ev = self.events[s]
-            sp[i] = self.sp_slot[s]
-            op[i] = self.op_slot[s]
+            sps, ops = self.sp_slot[s], self.op_slot[s]
+            sp[i] = sps - base if sps >= 0 else -1
+            op[i] = ops - base if ops >= 0 else -1
             creator[i] = self.participants[ev.creator]
             seq[i] = ev.index
             ts[i] = ev.body.timestamp
@@ -163,17 +212,9 @@ class HostDag:
     # wire conversion (reference hashgraph.go:496-571)
 
     def to_wire(self, event: Event) -> WireEvent:
-        sp = event.self_parent
-        op = event.other_parent
-        sp_index = self.events[self.slot_of[sp]].index if sp else -1
-        if op:
-            op_ev = self.events[self.slot_of[op]]
-            op_creator_id = self.participants[op_ev.creator]
-            op_index = op_ev.index
-        else:
-            op_creator_id = op_index = -1
+        sp_index, op_cid, op_index = self.wire_meta[self.slot_of[event.hex()]]
         return event.to_wire(
-            sp_index, op_creator_id, op_index, self.participants[event.creator]
+            sp_index, op_cid, op_index, self.participants[event.creator]
         )
 
     def read_wire_info(self, wevent: WireEvent) -> Event:
@@ -201,7 +242,8 @@ class HostDag:
 
     def participant_events(self, creator: str, skip: int) -> List[str]:
         """Event hexes of `creator` with seq >= skip (the gossip diff unit,
-        reference node/core.go:108-132)."""
+        reference node/core.go:108-132).  Raises TooLateError when `skip`
+        falls below the rolling window (reference caches.go:59-72)."""
         cid = self.participants[creator]
         return [self.events[s].hex() for s in self.chains[cid][skip:]]
 

@@ -26,13 +26,21 @@ class Core:
         commit_callback: Optional[Callable[[List[Event]], None]] = None,
         engine: Optional[TpuHashgraph] = None,
         e_cap: int = 4096,
+        cache_size: Optional[int] = None,
     ):
         self.id = core_id
         self.key = key
         self.pub_hex = key.pub_hex
         self.participants = participants
+        # The live path runs with rolling windows on (auto_compact): memory
+        # stays bounded and peers that fall behind the cache_size window get
+        # TooLateError through the sync path, like the reference's rolling
+        # caches (caches.go:45-76).
         self.hg = engine or TpuHashgraph(
-            participants, commit_callback=commit_callback, e_cap=e_cap
+            participants, commit_callback=commit_callback, e_cap=e_cap,
+            auto_compact=cache_size is not None,
+            seq_window=cache_size or 256,
+            consensus_window=2 * cache_size if cache_size else None,
         )
         self.head: str = ""
         self.seq: int = -1

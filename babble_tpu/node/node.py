@@ -54,6 +54,7 @@ class Node:
             own_id, key, participants,
             commit_callback=None, engine=engine,
             e_cap=max(conf.cache_size, 64),
+            cache_size=conf.cache_size,
         )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
@@ -305,6 +306,8 @@ class Node:
             "events_per_second": f"{events_per_sec:.2f}",
             "rounds_per_second": f"{rounds_per_sec:.2f}",
             "round_events": str(snap["last_committed_round_events"]),
+            "evicted_events": str(snap["evicted_events"]),
+            "live_window": str(snap["live_window"]),
             "id": str(self.core.id),
             **{k: f"{v:.2f}" for k, v in self.timings.items()},
         }

@@ -504,3 +504,41 @@ def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
 
 
 ingest = jax.jit(ingest_impl, static_argnums=(0, 2), donate_argnums=(1,))
+
+
+def rescan_rounds_impl(
+    cfg: DagConfig, state: DagState, sched: jnp.ndarray
+) -> DagState:
+    """Re-run round assignment for a level-grouped schedule of suspect
+    slots (engine._repair_rounds): used after growing r_cap, when writes
+    at rounds past the old capacity were clipped.  Resets the suspects'
+    round/witness, then replays the level scan against the intact lower
+    witness rows."""
+    e1 = cfg.e_cap + 1
+    raw = sched
+    slots = jnp.where(raw >= 0, raw, cfg.e_cap)
+    mask = jnp.zeros((e1,), bool).at[slots.ravel()].max(raw.ravel() >= 0)
+    mask = jnp.where(jnp.arange(e1) == cfg.e_cap, False, mask)
+    rnd = jnp.where(mask, -1, state.round)
+    wit = state.witness & ~mask
+    live = (jnp.arange(e1) < state.n_events) & (state.seq >= 0)
+    state = state._replace(
+        round=rnd,
+        witness=wit,
+        max_round=jnp.max(jnp.where(live, rnd, -1)),
+    )
+    state = _rounds_level_scan(state, cfg, slots, raw)
+    # The scan's padded lanes dumped slot indices into wslot row r_cap (and
+    # -1/False into event row e_cap); restore the sentinels like every
+    # ingest path does, or a later compact() gather would roll the dirty
+    # dump row into live round rows as phantom witnesses.
+    e_row = jnp.arange(e1) == cfg.e_cap
+    r_row = (jnp.arange(cfg.r_cap + 1) == cfg.r_cap)[:, None]
+    return state._replace(
+        round=set_sentinel(state.round, e_row, -1),
+        witness=set_sentinel(state.witness, e_row, False),
+        wslot=set_sentinel(state.wslot, r_row, -1),
+    )
+
+
+rescan_rounds = jax.jit(rescan_rounds_impl, static_argnums=(0,), donate_argnums=(1,))

@@ -235,26 +235,6 @@ def compact_impl(
 compact = jax.jit(compact_impl, static_argnums=(0,), donate_argnums=(1,))
 
 
-def rebuild_wslot_impl(cfg: DagConfig, state: DagState) -> DagState:
-    """Recompute the creator-indexed witness table from the per-event
-    round/witness arrays (used after growing r_cap: earlier witness writes
-    at rounds >= the old capacity were clipped into the dump row)."""
-    e1 = cfg.e_cap + 1
-    valid = state.witness & (jnp.arange(e1) < state.n_events) & (state.seq >= 0)
-    r_loc = jnp.where(valid, state.round - state.r_off, cfg.r_cap)
-    r_loc = jnp.clip(r_loc, 0, cfg.r_cap)
-    wslot = jnp.full((cfg.r_cap + 1, cfg.n), -1, I32)
-    wslot = wslot.at[r_loc, jnp.clip(state.creator, 0, cfg.n - 1)].set(
-        jnp.where(valid, jnp.arange(e1, dtype=I32), -1).astype(I32)
-    )
-    # dump-row writes (invalid lanes) all landed in row r_cap; restore it
-    r_row = (jnp.arange(cfg.r_cap + 1) == cfg.r_cap)[:, None]
-    return state._replace(wslot=set_sentinel(wslot, r_row, -1))
-
-
-rebuild_wslot = jax.jit(rebuild_wslot_impl, static_argnums=(0,), donate_argnums=(1,))
-
-
 def sanitize(idx: jnp.ndarray, sentinel: int) -> jnp.ndarray:
     """Remap negative (missing) indices to the sentinel row."""
     return jnp.where(idx < 0, sentinel, idx)

@@ -5,11 +5,12 @@ The reference has no persistence at all — its Store interface is the
 README.md:140-141) and a crashed node can never rejoin.  Here the seam is
 real: a checkpoint captures
 
-- the host DAG (events in wire form, topologically ordered — the compact
-  (creatorID, index) parent encoding of reference event.go:244-254),
-- the consensus log + commit bookkeeping,
-- the dense device tensors (DagState), so resume is a bulk load instead of
-  a full re-ingest.
+- the host DAG *window* (full signed events plus the per-slot index
+  arrays — levels, parent slots, wire coordinates — so restore is a direct
+  reconstruction, not a replay that would need evicted ancestors),
+- the consensus log window + commit bookkeeping,
+- the dense device tensors (DagState, including the rolling-window
+  offsets), so resume is a bulk load instead of a full re-ingest.
 
 Layout: ``<dir>/meta.msgpack`` + ``<dir>/device.npz``.  Writes go to a
 temp directory swapped in atomically, so a crash mid-save never corrupts
@@ -26,13 +27,41 @@ from typing import Callable, Dict, List, Optional
 import msgpack
 import numpy as np
 
+from ..common import OffsetList
 from ..consensus.engine import TpuHashgraph
+from ..core.event import Event, EventBody
 from ..ops.state import DagConfig, DagState
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
 
 _META = "meta.msgpack"
 _DEVICE = "device.npz"
+
+
+def _pack_event(ev: Event) -> list:
+    """Full self-contained encoding (parent *hashes*, unlike the compact
+    wire form) — restore must not need evicted parent objects."""
+    return [
+        list(ev.body.transactions),
+        ev.body.self_parent,
+        ev.body.other_parent,
+        ev.body.creator,
+        ev.body.timestamp,
+        ev.body.index,
+        ev.r.to_bytes(32, "big"),   # 256-bit ECDSA ints exceed msgpack int64
+        ev.s.to_bytes(32, "big"),
+    ]
+
+
+def _unpack_event(obj: list) -> Event:
+    txs, sp, op, creator, ts, idx, r, s = obj
+    return Event(
+        body=EventBody(
+            transactions=list(txs), self_parent=sp, other_parent=op,
+            creator=creator, timestamp=ts, index=idx,
+        ),
+        r=int.from_bytes(r, "big"), s=int.from_bytes(s, "big"),
+    )
 
 
 def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
@@ -40,20 +69,26 @@ def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
     engine.flush()  # device state must reflect every inserted event
 
     dag = engine.dag
-    wire_events = []
-    for ev in dag.events:  # slot order == topological order
-        w = dag.to_wire(ev)
-        wire_events.append(w.pack())
-
     meta = {
         "version": FORMAT_VERSION,
         "participants": sorted(engine.participants.items()),
         "cfg": list(engine.cfg),
         "verify_signatures": dag.verify_signatures,
-        "events": wire_events,
-        "consensus": engine.consensus,
+        "policy": [
+            engine.auto_compact, engine.seq_window, engine.round_margin,
+            engine.compact_min, engine.consensus_window,
+        ],
+        "slot_base": dag.slot_base,
+        "events": [_pack_event(ev) for ev in dag.events],  # window, slot order
+        "levels": list(dag.levels),
+        "sp_slot": list(dag.sp_slot),
+        "op_slot": list(dag.op_slot),
+        "wire_meta": [list(m) for m in dag.wire_meta],
+        "chains": [[c.start, list(c)] for c in dag.chains],
+        "consensus": [engine.consensus.start, list(engine.consensus)],
         "consensus_transactions": engine.consensus_transactions,
         "last_committed_round_events": engine.last_committed_round_events,
+        "ordered_total": engine._ordered_total,
         "received": sorted(engine._received),
     }
 
@@ -91,27 +126,40 @@ def load_checkpoint(
 
     participants: Dict[str, int] = {k: int(v) for k, v in meta["participants"]}
     cfg = DagConfig(*meta["cfg"])
+    auto_compact, seq_window, round_margin, compact_min, cons_window = (
+        meta["policy"]
+    )
     engine = TpuHashgraph(
         participants,
         commit_callback=commit_callback,
         verify_signatures=meta["verify_signatures"],
         e_cap=cfg.e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
+        auto_compact=auto_compact, seq_window=seq_window,
+        round_margin=round_margin, compact_min=compact_min,
+        consensus_window=cons_window,
     )
     engine.cfg = cfg
 
-    # Replay the host index.  Signatures were verified before the events
-    # entered the saved state — skip re-verification for bulk-load speed.
-    from ..core.event import WireEvent
-
+    # Rebuild the host index directly from the saved window (no replay:
+    # signatures were verified before the events entered the saved state,
+    # and parents below the window no longer exist).
     dag = engine.dag
-    saved_verify = dag.verify_signatures
-    dag.verify_signatures = False
-    try:
-        for packed in meta["events"]:
-            dag.insert(dag.read_wire_info(WireEvent.unpack(packed)))
-    finally:
-        dag.verify_signatures = saved_verify
-    dag.pending.clear()  # the device tensors below already contain them
+    base = meta["slot_base"]
+    events = [_unpack_event(o) for o in meta["events"]]
+    for i, ev in enumerate(events):
+        ev.topological_index = base + i
+    dag.events = OffsetList(events, base)
+    dag.slot_of = {ev.hex(): base + i for i, ev in enumerate(events)}
+    dag.levels = OffsetList(meta["levels"], base)
+    dag.sp_slot = OffsetList(meta["sp_slot"], base)
+    dag.op_slot = OffsetList(meta["op_slot"], base)
+    dag.wire_meta = OffsetList(
+        [tuple(m) for m in meta["wire_meta"]], base
+    )
+    dag.chains = [
+        OffsetList(items, start) for start, items in meta["chains"]
+    ]
+    dag.pending = []  # the device tensors below already contain them
 
     import jax.numpy as jnp
 
@@ -120,8 +168,12 @@ def load_checkpoint(
             **{name: jnp.asarray(z[name]) for name in DagState._fields}
         )
 
-    engine.consensus = list(meta["consensus"])
+    cons_start, cons_items = meta["consensus"]
+    engine.consensus = OffsetList(cons_items, cons_start)
     engine.consensus_transactions = meta["consensus_transactions"]
     engine.last_committed_round_events = meta["last_committed_round_events"]
+    engine._ordered_total = meta["ordered_total"]
     engine._received = set(meta["received"])
+    engine._r_off = int(np.asarray(engine.state.r_off))
+    engine._lcr_cache = int(np.asarray(engine.state.lcr))
     return engine

@@ -1,0 +1,206 @@
+"""Rolling-window / bounded-memory tests (reference caches.go semantics).
+
+The live path must stay flat in memory forever: committed prefixes roll off
+the device tensors and the host index, peers that fall behind the window
+get TooLateError through the sync path, and none of it may change a single
+consensus decision — the compacting engine must emit exactly the same
+committed sequence as an unbounded one.
+"""
+
+import numpy as np
+import pytest
+
+from babble_tpu.common import OffsetList, TooLateError, KeyNotFoundError
+from babble_tpu.consensus.engine import TpuHashgraph
+from babble_tpu.sim import random_gossip_dag
+
+
+def _run_chunks(engine, events, chunk):
+    for i, ev in enumerate(events):
+        engine.insert_event(ev.clone())
+        if (i + 1) % chunk == 0:
+            engine.run_consensus()
+    engine.run_consensus()
+
+
+def _rolled_engine(dag, **kw):
+    args = dict(
+        e_cap=256, s_cap=64, r_cap=32, verify_signatures=False,
+        auto_compact=True, seq_window=8, compact_min=16, round_margin=2,
+    )
+    args.update(kw)
+    return TpuHashgraph(dag.participants, **args)
+
+
+# ----------------------------------------------------------------------
+# OffsetList primitive
+
+
+def test_offset_list_semantics():
+    ol = OffsetList()
+    for i in range(10):
+        ol.append(i * 10)
+    assert len(ol) == 10 and ol[0] == 0 and ol[-1] == 90
+    assert ol[3:6] == [30, 40, 50]
+    assert ol.evict_to(4) == [0, 10, 20, 30]
+    assert len(ol) == 10                 # absolute indices survive eviction
+    assert ol[4] == 40 and ol[-1] == 90
+    with pytest.raises(TooLateError):
+        ol[3]
+    with pytest.raises(TooLateError):
+        ol[0:6]
+    with pytest.raises(KeyNotFoundError):
+        ol[10]
+    assert ol[4:] == [40, 50, 60, 70, 80, 90]
+    assert list(ol) == [40, 50, 60, 70, 80, 90]
+
+
+# ----------------------------------------------------------------------
+# compaction must not change any consensus decision
+
+
+@pytest.mark.parametrize("n,n_events,seed,chunk", [(4, 400, 77, 16), (5, 500, 78, 23)])
+def test_compaction_matches_uncompacted(n, n_events, seed, chunk):
+    dag = random_gossip_dag(n, n_events, seed=seed)
+    plain = TpuHashgraph(
+        dag.participants, e_cap=1024, s_cap=256, r_cap=64,
+        verify_signatures=False,
+    )
+    rolled = _rolled_engine(dag)
+    _run_chunks(plain, dag.events, chunk)
+    _run_chunks(rolled, dag.events, chunk)
+
+    assert rolled.dag.slot_base > 0, "compaction never ran"
+    assert rolled._r_off > 0, "round window never rolled"
+    assert plain.consensus_events() == rolled.consensus_events()
+    assert plain.consensus_transactions == rolled.consensus_transactions
+    assert plain.last_consensus_round == rolled.last_consensus_round
+    assert plain.undetermined_count == rolled.undetermined_count
+
+
+def test_window_stays_bounded():
+    """The device window (live rows) must not scale with total history:
+    e_cap settles and stops growing while history keeps doubling."""
+    dag = random_gossip_dag(4, 1200, seed=79)
+    rolled = _rolled_engine(dag)
+    caps = []
+    for i, ev in enumerate(dag.events):
+        rolled.insert_event(ev.clone())
+        if (i + 1) % 16 == 0:
+            rolled.run_consensus()
+            caps.append(rolled.cfg.e_cap)
+    rolled.run_consensus()
+    # capacity reached a fixed point long before the end of the run
+    settle = caps[len(caps) // 3]
+    assert caps[-1] == settle, f"e_cap kept growing: {caps}"
+    live = rolled.dag.n_events - rolled.dag.slot_base
+    assert live <= rolled.cfg.e_cap
+    assert rolled.dag.slot_base > rolled.cfg.e_cap, (
+        "evicted history should dwarf the live window"
+    )
+    # the host window really dropped the objects
+    assert len(rolled.dag.events.window) == live
+
+
+# ----------------------------------------------------------------------
+# TooLate surface (reference caches.go:59-72 via the gossip diff path)
+
+
+def test_evicted_window_sync_too_late():
+    dag = random_gossip_dag(4, 600, seed=80)
+    rolled = _rolled_engine(dag)
+    _run_chunks(rolled, dag.events, 16)
+    assert rolled.dag.slot_base > 0
+
+    some_pub = next(iter(dag.participants))
+    cid = dag.participants[some_pub]
+    start = rolled.dag.chains[cid].start
+    assert start > 0, "no chain eviction happened"
+    # a peer that knows nothing (skip=0) is below the window -> too late
+    with pytest.raises(TooLateError):
+        rolled.dag.participant_events(some_pub, 0)
+    # a peer inside the window still syncs fine
+    tail = rolled.dag.participant_events(some_pub, start)
+    assert len(tail) == len(rolled.dag.chains[cid]) - start
+
+    # wire resolution of an evicted parent index is too late as well
+    from babble_tpu.core.event import WireEvent
+
+    w = WireEvent(
+        transactions=[], self_parent_index=0, other_parent_creator_id=cid,
+        other_parent_index=0, creator_id=(cid + 1) % 4, index=1,
+        timestamp=0, r=1, s=1,
+    )
+    with pytest.raises(TooLateError):
+        rolled.dag.read_wire_info(w)
+
+
+def test_core_diff_propagates_too_late():
+    """Core.diff must surface TooLateError for a stale Known vector — the
+    node responds with an error instead of unbounded history (the analogue
+    of the reference returning ErrTooLate from participant_events)."""
+    from types import SimpleNamespace
+
+    from babble_tpu.node.core import Core
+
+    dag = random_gossip_dag(4, 600, seed=81)
+    rolled = _rolled_engine(dag)
+    _run_chunks(rolled, dag.events, 16)
+    assert rolled.dag.slot_base > 0
+
+    parts = dict(dag.participants)
+    pub = next(p for p, cid in parts.items() if cid == 0)
+    key = SimpleNamespace(pub_hex=pub, pub_bytes=bytes.fromhex(pub[2:]))
+    core = Core(0, key, parts, engine=rolled)
+    with pytest.raises(TooLateError):
+        core.diff({cid: 0 for cid in range(4)})
+
+
+# ----------------------------------------------------------------------
+# checkpoint across a compacted window
+
+
+def test_checkpoint_after_compaction(tmp_path):
+    from babble_tpu.store import load_checkpoint, save_checkpoint
+
+    dag = random_gossip_dag(4, 500, seed=82)
+    rolled = _rolled_engine(dag)
+    half = 400
+    _run_chunks(rolled, dag.events[:half], 16)
+    assert rolled.dag.slot_base > 0
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(rolled, path)
+    resumed = load_checkpoint(path)
+    assert resumed.dag.slot_base == rolled.dag.slot_base
+    assert resumed.consensus_events() == rolled.consensus_events()
+
+    # both continue identically over the remaining stream
+    for ev in dag.events[half:]:
+        rolled.insert_event(ev.clone())
+        resumed.insert_event(ev.clone())
+    rolled.run_consensus()
+    resumed.run_consensus()
+    assert resumed.consensus_events() == rolled.consensus_events()
+    assert resumed.last_consensus_round == rolled.last_consensus_round
+
+
+# ----------------------------------------------------------------------
+# round-window growth repair (wslot clipping recovery without re-ingest)
+
+
+def test_round_repair_with_tiny_r_cap():
+    """Start with r_cap too small for the stream: saturation must repair
+    in place (no full re-ingest is possible once history is evicted) and
+    still match an engine that had room from the start."""
+    dag = random_gossip_dag(4, 400, seed=83)
+    roomy = TpuHashgraph(
+        dag.participants, e_cap=1024, s_cap=256, r_cap=128,
+        verify_signatures=False,
+    )
+    tight = _rolled_engine(dag, r_cap=4, round_margin=1)
+    _run_chunks(roomy, dag.events, 16)
+    _run_chunks(tight, dag.events, 16)
+    assert tight.cfg.r_cap > 4, "round capacity never grew"
+    assert roomy.consensus_events() == tight.consensus_events()
+    assert roomy.last_consensus_round == tight.last_consensus_round
