@@ -1,19 +1,29 @@
 """Consensus engines.
 
-Two implementations of the same hashgraph virtual-voting semantics
+Implementations of the hashgraph virtual-voting semantics
 (reference: hashgraph/hashgraph.go):
 
 - ``oracle.OracleHashgraph`` — a straight-line, hash-by-hash Python engine
   faithful to the reference.  Slow, obviously correct; used as the
   differential-test anchor and for tiny deployments.
-- ``engine.TpuHashgraph`` (forthcoming) — the TPU-native engine: dense
-  ``(E, N)`` coordinate tensors in device memory, jitted level-scans and
-  batched vote matmuls.  The production path.
+- ``engine.TpuHashgraph`` — the TPU-native engine: dense ``(E, N)``
+  coordinate tensors in device memory, jitted level-scans and batched vote
+  matmuls, rolling windows for bounded memory.  The production path.
+- ``byzantine.ForkOracle`` / ``fork_engine.ForkHashgraph`` — fork-aware
+  (byzantine-mode) pair: the paper's fork-detecting See/StronglySee, which
+  the reference never implements (it rejects forks at insert,
+  hashgraph.go:366-396).  Oracle anchors semantics; ForkHashgraph runs the
+  dense branch kernels (ops/forks.py).
 
-Both must produce identical consensus orders; the differential test suite
-enforces this once the TPU engine lands.
+Every engine pair must produce identical consensus orders; the
+differential test suites enforce it (tests/test_engine.py,
+tests/test_forks.py).
+
+NOTE: importing engine/fork_engine pulls in the jitted kernels (and x64
+config); import ``.oracle``/``.byzantine`` directly for pure-Python use.
 """
 
+from .byzantine import ForkOracle
 from .oracle import OracleHashgraph
 
-__all__ = ["OracleHashgraph"]
+__all__ = ["ForkOracle", "OracleHashgraph"]

@@ -39,6 +39,7 @@ from ..ops.state import (
     INT32_MAX,
     DagConfig,
     DagState,
+    bucket,
     compact as compact_op,
     grow_state,
     init_state,
@@ -46,10 +47,7 @@ from ..ops.state import (
 
 _FD_FULL_THRESHOLD = 2048  # batch size above which full FD recompute wins
 
-
-def _bucket(x: int, minimum: int = 8) -> int:
-    v = max(x, minimum)
-    return 1 << (v - 1).bit_length()
+_bucket = bucket
 
 
 class TpuHashgraph:

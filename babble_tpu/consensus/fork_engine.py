@@ -1,0 +1,173 @@
+"""ForkHashgraph: byzantine-mode consensus engine (batch execution).
+
+Pairs the host ForkDag (branch assignment, chain views) with the dense
+branch kernels (ops/forks.py) and emits the same commit surface as
+TpuHashgraph.  Differentially tested against consensus/byzantine.py
+(the definition-first oracle) on forked DAGs, and against the honest
+engine on fork-free DAGs.
+
+Execution model is whole-DAG batch: each run_consensus() call re-runs the
+pipeline over everything inserted so far from a fresh device state.  That
+matches the byzantine bench shape (BASELINE "1024-node, 1/3 forks") and
+keeps this engine simple; a fork-aware incremental/live path would reuse
+the same kernels against a persistent state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.event import Event
+from ..ops.forks import (
+    FAME_TRUE,
+    FAME_UNDEFINED,
+    ForkConfig,
+    ForkDag,
+    fork_pipeline,
+)
+from ..ops.state import bucket as _bucket
+from .ordering import consensus_sort
+
+
+class ForkHashgraph:
+    def __init__(
+        self,
+        participants: Dict[str, int],
+        k: int = 2,
+        commit_callback=None,
+    ):
+        self.participants = participants
+        self.k = k
+        self.dag = ForkDag(participants, k=k)
+        self.commit_callback = commit_callback
+        self.consensus: List[str] = []
+        self.consensus_transactions = 0
+        self._received: set = set()
+        self._out = None
+        self._dirty = True
+
+    @property
+    def n(self) -> int:
+        return len(self.participants)
+
+    def insert_event(self, event: Event) -> None:
+        self.dag.insert(event)
+        self._dirty = True
+
+    # ------------------------------------------------------------------
+
+    def _run(self):
+        if not self._dirty and self._out is not None:
+            return self._out
+        ne = len(self.dag.events)
+        max_chain = max(
+            (len(self.dag._chain_slots(c))
+             for c in range(self.dag.b) if self.dag.br_used[c]),
+            default=0,
+        )
+        max_lvl = max(self.dag.levels, default=0)
+        cfg = ForkConfig(
+            n=self.n, k=self.k,
+            e_cap=_bucket(ne),
+            s_cap=_bucket(max_chain + 1, 8),
+            r_cap=_bucket(max_lvl + 2, 8),
+        )
+        batch = self.dag.build_batch(cfg)
+        self._out = (cfg, fork_pipeline(cfg, batch))
+        self._dirty = False
+        return self._out
+
+    # ------------------------------------------------------------------
+    # predicate surface (differential tests)
+
+    def _slot(self, x: str) -> int:
+        return self.dag.slot_of[x]
+
+    def round(self, x: str) -> int:
+        cfg, out = self._run()
+        return int(np.asarray(out.round)[self._slot(x)])
+
+    def witness(self, x: str) -> bool:
+        cfg, out = self._run()
+        return bool(np.asarray(out.witness)[self._slot(x)])
+
+    def see(self, x: str, y: str) -> bool:
+        cfg, out = self._run()
+        sx, sy = self._slot(x), self._slot(y)
+        la = np.asarray(out.la)
+        det = np.asarray(out.det)
+        br = self.dag.ebr[sy]
+        cy = self.participants[self.dag.events[sy].creator]
+        return bool(
+            la[sx, br] >= self.dag.events[sy].index and not det[sx, cy]
+        )
+
+    def detects_fork(self, x: str, cid: int) -> bool:
+        cfg, out = self._run()
+        return bool(np.asarray(out.det)[self._slot(x), cid])
+
+    def famous_of(self, r: int, x: str) -> Optional[bool]:
+        cfg, out = self._run()
+        if r < 0 or r >= cfg.r_cap:
+            return None
+        wslot = np.asarray(out.wslot)
+        famous = np.asarray(out.famous)
+        sx = self._slot(x)
+        for col in range(cfg.b):
+            if wslot[r, col] == sx:
+                f = famous[r, col]
+                return None if f == FAME_UNDEFINED else bool(f == FAME_TRUE)
+        return None
+
+    def max_round(self) -> int:
+        cfg, out = self._run()
+        return int(np.asarray(out.max_round))
+
+    @property
+    def lcr(self) -> int:
+        cfg, out = self._run()
+        return int(np.asarray(out.lcr))
+
+    # ------------------------------------------------------------------
+
+    def run_consensus(self) -> List[Event]:
+        cfg, out = self._run()
+        rr = np.asarray(out.rr)
+        cts = np.asarray(out.cts)
+        wslot = np.asarray(out.wslot)
+        famous = np.asarray(out.famous)
+        ne = len(self.dag.events)
+
+        new_events: List[Event] = []
+        for s in range(ne):
+            if rr[s] < 0 or s in self._received:
+                continue
+            ev = self.dag.events[s]
+            ev.round_received = int(rr[s])
+            ev.consensus_timestamp = int(cts[s])
+            new_events.append(ev)
+            self._received.add(s)
+        if not new_events:
+            return []
+
+        def prn(r: int) -> int:
+            if r < 0 or r >= cfg.r_cap:
+                return 0
+            res = 0
+            for col in range(cfg.b):
+                if wslot[r, col] >= 0 and famous[r, col] == FAME_TRUE:
+                    res ^= int(self.dag.events[int(wslot[r, col])].hex(), 16)
+            return res
+
+        new_events = consensus_sort(new_events, prn)
+        for ev in new_events:
+            self.consensus.append(ev.hex())
+            self.consensus_transactions += len(ev.transactions)
+        if self.commit_callback is not None:
+            self.commit_callback(new_events)
+        return new_events
+
+    def consensus_events(self) -> List[str]:
+        return list(self.consensus)

@@ -38,7 +38,7 @@ class Core:
         # caches (caches.go:45-76).
         self.hg = engine or TpuHashgraph(
             participants, commit_callback=commit_callback, e_cap=e_cap,
-            auto_compact=cache_size is not None,
+            auto_compact=bool(cache_size),   # 0/None = unbounded history
             seq_window=cache_size or 256,
             consensus_window=2 * cache_size if cache_size else None,
         )

@@ -235,6 +235,12 @@ def compact_impl(
 compact = jax.jit(compact_impl, static_argnums=(0,), donate_argnums=(1,))
 
 
+def bucket(x: int, minimum: int = 8) -> int:
+    """Round a capacity up to a power of two (bounds jit recompilation)."""
+    v = max(x, minimum)
+    return 1 << (v - 1).bit_length()
+
+
 def sanitize(idx: jnp.ndarray, sentinel: int) -> jnp.ndarray:
     """Remap negative (missing) indices to the sentinel row."""
     return jnp.where(idx < 0, sentinel, idx)

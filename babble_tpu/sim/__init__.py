@@ -11,10 +11,10 @@ from .arrays import (
     build_schedule,
     random_gossip_arrays,
 )
-from .generator import GeneratedDag, random_gossip_dag
+from .generator import GeneratedDag, random_byzantine_dag, random_gossip_dag
 
 __all__ = [
-    "GeneratedDag", "random_gossip_dag",
+    "GeneratedDag", "random_gossip_dag", "random_byzantine_dag",
     "ArrayDag", "random_gossip_arrays", "build_schedule",
     "batch_from_arrays",
 ]

@@ -89,3 +89,79 @@ def random_gossip_dag(
         seqs[receiver] += 1
 
     return GeneratedDag(participants, events, n, seed)
+
+
+def random_byzantine_dag(
+    n: int,
+    n_events: int,
+    byz_frac: float = 1 / 3,
+    fork_rate: float = 0.05,
+    forks_per_node: int = 1,
+    seed: int = 0,
+    ts_granularity_ns: int = 1_000,
+    base_ts: int = 1_700_000_000_000_000_000,
+) -> GeneratedDag:
+    """Gossip DAG with equivocating creators (the BASELINE byzantine
+    config): the first ``floor(byz_frac * n)`` participants fork with
+    probability ``fork_rate`` per event they create — instead of extending
+    their latest head they extend a random *earlier* own event, producing
+    two events at the same index (a fork).  Each forker equivocates at most
+    ``forks_per_node`` times (the engine's per-creator branch budget K-1;
+    an equivocation-spam guard would cut a real spammer off the same way).  Honest consumers of this DAG
+    must run fork-aware See/StronglySee (consensus/byzantine.py,
+    ops/forks.py); the reference engine would reject these streams at
+    insert (hashgraph.go:366-396)."""
+    rng = np.random.default_rng(seed)
+    participants = {("0x" + _fake_pub(i).hex().upper()): i for i in range(n)}
+    pubs = [_fake_pub(i) for i in range(n)]
+    # BFT bound: once a creator's fork is visible, nobody can see its
+    # events, so rounds only advance while the *honest* creators alone
+    # reach a supermajority — cap forkers at n - (2n/3+1) (< n/3 strict)
+    n_byz = min(int(byz_frac * n), n - (2 * n // 3 + 1))
+
+    events: List[Event] = []
+    # per creator: list of (hex, index) of every own event (fork targets)
+    own: List[List[tuple]] = [[] for _ in range(n)]
+    forks_left = [forks_per_node if i < n_byz else 0 for i in range(n)]
+    heads: List[Optional[str]] = [None] * n
+
+    def sign_fake(ev: Event) -> None:
+        ev.r = int(rng.integers(1, 1 << 62)) << 64 | int(rng.integers(0, 1 << 62))
+        ev.s = int(rng.integers(1, 1 << 62)) << 64 | int(rng.integers(0, 1 << 62))
+
+    t = 0
+    for i in range(n):
+        ev = new_event([], ("", ""), pubs[i], 0, timestamp=base_ts)
+        sign_fake(ev)
+        events.append(ev)
+        own[i].append((ev.hex(), 0))
+        heads[i] = ev.hex()
+        if len(events) >= n_events:
+            return GeneratedDag(participants, events, n, seed)
+
+    while len(events) < n_events:
+        t += 1
+        receiver = int(rng.integers(0, n))
+        sender = int(rng.integers(0, n - 1))
+        if sender >= receiver:
+            sender += 1
+        raw = t * 1_987_963
+        ts = base_ts + (raw // ts_granularity_ns) * ts_granularity_ns
+
+        sp_hex, sp_idx = own[receiver][-1][0], own[receiver][-1][1]
+        if (forks_left[receiver] > 0 and len(own[receiver]) > 1
+                and rng.random() < fork_rate):
+            # equivocate: extend a random earlier own event
+            j = int(rng.integers(0, len(own[receiver]) - 1))
+            sp_hex, sp_idx = own[receiver][j]
+            forks_left[receiver] -= 1
+        ev = new_event(
+            [], (sp_hex, heads[sender]), pubs[receiver], sp_idx + 1,
+            timestamp=ts,
+        )
+        sign_fake(ev)
+        events.append(ev)
+        own[receiver].append((ev.hex(), sp_idx + 1))
+        heads[receiver] = ev.hex()
+
+    return GeneratedDag(participants, events, n, seed)

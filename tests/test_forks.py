@@ -1,0 +1,136 @@
+"""Fork-aware (byzantine) consensus: differential tests.
+
+Three-way anchor chain:
+- ForkOracle (definition-first, hashgraph paper) vs the honest oracle on
+  fork-free DAGs — proves the fork-aware semantics degrade to reference
+  behavior when nobody equivocates;
+- dense branch kernels (ops/forks.py via ForkHashgraph) vs ForkOracle on
+  forked DAGs — the byzantine-mode correctness argument;
+- fork bookkeeping unit checks (budget, detection, seeing).
+
+The reference has no counterpart to any of this: it rejects forks at
+insert (hashgraph.go:366-396) and skips fork detection in See
+(hashgraph.go:149-154).
+"""
+
+import pytest
+
+from babble_tpu.consensus.byzantine import ForkOracle
+from babble_tpu.consensus.fork_engine import ForkHashgraph
+from babble_tpu.consensus.oracle import OracleHashgraph
+from babble_tpu.ops.forks import ForkBudgetError
+from babble_tpu.sim import random_byzantine_dag, random_gossip_dag
+from babble_tpu.store.inmem import InmemStore
+
+
+def _fill(dag, *engines):
+    for ev in dag.events:
+        for e in engines:
+            e.insert_event(ev.clone())
+
+
+def _assert_match(dag, fo: ForkOracle, fh: ForkHashgraph):
+    for ev in dag.events:
+        x = ev.hex()
+        assert fh.round(x) == fo.round(x), f"round {x[:10]}"
+        assert fh.witness(x) == fo.witness(x), f"witness {x[:10]}"
+    # fame parity on every witness of every round
+    for r in range(fo.max_round() + 1):
+        for w in fo.round_witnesses(r):
+            assert fh.famous_of(r, w) == fo.famous[w], f"fame r={r} {w[:10]}"
+    assert fh.consensus_events() == fo.consensus_events()
+    assert fh.lcr == fo.lcr
+
+
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,e,seed", [(4, 150, 1), (5, 200, 2)])
+def test_fork_oracle_degrades_to_reference_on_honest_dags(n, e, seed):
+    dag = random_gossip_dag(n, e, seed=seed)
+    fo = ForkOracle(dag.participants)
+    store = InmemStore(dag.participants, cache_size=100_000)
+    oh = OracleHashgraph(
+        participants=dag.participants, store=store, verify_signatures=False
+    )
+    _fill(dag, fo, oh)
+    fo.run_consensus()
+    oh.divide_rounds()
+    oh.decide_fame()
+    oh.find_order()
+    assert fo.consensus_events() == oh.consensus_events()
+    for ev in dag.events:
+        assert fo.round(ev.hex()) == oh.round(ev.hex())
+        assert fo.witness(ev.hex()) == oh.witness(ev.hex())
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_dense_matches_oracle_on_honest_dag(k):
+    dag = random_gossip_dag(4, 120, seed=7)
+    fo = ForkOracle(dag.participants)
+    fh = ForkHashgraph(dag.participants, k=k)
+    _fill(dag, fo, fh)
+    fo.run_consensus()
+    fh.run_consensus()
+    _assert_match(dag, fo, fh)
+
+
+@pytest.mark.parametrize(
+    "n,e,rate,seed",
+    [(6, 200, 0.08, 3), (7, 260, 0.05, 4), (9, 300, 0.1, 5)],
+)
+def test_dense_matches_oracle_on_byzantine_dag(n, e, rate, seed):
+    dag = random_byzantine_dag(n, e, seed=seed, fork_rate=rate)
+    fo = ForkOracle(dag.participants)
+    fh = ForkHashgraph(dag.participants, k=2)
+    _fill(dag, fo, fh)
+    fo.run_consensus()
+    fh.run_consensus()
+    pairs = sum(len(v) for v in fo._fork_pairs.values())
+    assert pairs > 0, "generator produced no forks"
+    _assert_match(dag, fo, fh)
+
+
+def test_forked_events_are_unseeable_once_detected():
+    """A detector of creator c's fork sees none of c's events (paper
+    semantics) — checked on both oracle and dense engine."""
+    dag = random_byzantine_dag(6, 200, seed=3, fork_rate=0.08)
+    fo = ForkOracle(dag.participants)
+    fh = ForkHashgraph(dag.participants, k=2)
+    _fill(dag, fo, fh)
+    fo.run_consensus()
+    fh.run_consensus()
+    checked = 0
+    for cid, pairs in fo._fork_pairs.items():
+        if not pairs:
+            continue
+        for x in dag.events[-20:]:
+            hx = x.hex()
+            det_o = fo.detects_fork(hx, cid)
+            assert fh.detects_fork(hx, cid) == det_o
+            if not det_o:
+                continue
+            for y in dag.events:
+                if fo.participants[y.creator] == cid:
+                    assert not fo.see(hx, y.hex())
+                    assert not fh.see(hx, y.hex())
+                    checked += 1
+    assert checked > 0, "no detection case exercised"
+
+
+def test_fork_budget_rejects_spam():
+    """Beyond K-1 forks, the branch budget cuts the equivocator off (the
+    dense engine's DoS guard; a real deployment would blacklist)."""
+    dag = random_byzantine_dag(
+        6, 300, seed=11, fork_rate=0.5, forks_per_node=5
+    )
+    fh = ForkHashgraph(dag.participants, k=2)
+    with pytest.raises(ForkBudgetError):
+        for ev in dag.events:
+            fh.insert_event(ev.clone())
+    # a budget matching the stream accepts it fine
+    fh6 = ForkHashgraph(dag.participants, k=6)
+    for ev in dag.events:
+        fh6.insert_event(ev.clone())
+    fh6.run_consensus()
+    assert len(fh6.consensus_events()) > 0
