@@ -191,7 +191,12 @@ class ForkOracle:
         max_r = self.max_round()
         wits = {r: self.round_witnesses(r) for r in range(max_r + 1)}
 
-        for i in range(self.lcr + 1, max_r + 1):
+        # scan from round 0, not lcr+1: lcr advances past undecided rounds
+        # (skip semantics), so a witness left undecided below lcr must be
+        # revisited on later calls — the dense engine recomputes fame from
+        # scratch and would otherwise diverge under incremental use.
+        # Already-decided witnesses short-circuit below.
+        for i in range(0, max_r + 1):
             for x in wits.get(i, []):
                 if self.famous[x] is not None:
                     continue

@@ -476,11 +476,31 @@ def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
     - 'full'        — chain-view fd searchsorted + level-scan rounds.
     - 'fast'        — chain-view fd + per-round frontier rounds (the
       batch/simulation path; identical outputs, differentially tested).
+    - 'walk'        — like 'fast' but la is filled by the Pallas
+      sequential-walk kernel (pallas_ingest.la_walk) instead of the level
+      scan: one in-VMEM pass over the slot order, ~1.8x faster than the
+      ~3,500-launch scan at 64x65k.  Gated by walk_supported().
     - 'absorb'      — like 'fast' but with log-depth la self-absorption
-      instead of the level scan; gather-bound on current XLA — kept as
-      the target shape for a pallas absorb kernel.
+      instead of the level scan; gather-bound on current XLA — superseded
+      by 'walk'.
     """
     state = _write_batch_fields(state, cfg, batch)
+    if fd_mode == "walk":
+        from .pallas_ingest import la_walk, unpack_la, walk_supported
+
+        assert walk_supported(cfg.n, cfg.e_cap, cfg.s_cap), cfg
+        interpret = jax.default_backend() != "tpu"
+        packed = la_walk(
+            cfg.e_cap, cfg.n, state.sp, state.op, state.creator,
+            state.seq, state.n_events, interpret,
+        )
+        state = state._replace(
+            la=unpack_la(cfg.e_cap, cfg.n, packed, state.n_events)
+        )
+        state = _fd_init_own(state, cfg, batch)
+        state = _fd_full(state, cfg)
+        state = _rounds_frontier(state, cfg)
+        return _reset_event_sentinels(state, cfg)
     if fd_mode == "absorb":
         state = _la_init_direct(state, cfg, batch)
         state = _la_absorb(state, cfg)

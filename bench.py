@@ -75,7 +75,13 @@ def run_config(n, e, s_cap_min, r_cap):
         log(f"[{n}x{e}] C++ reference baseline: {base_t:.3f}s, "
             f"{base_ordered} ordered -> {base_eps:,.0f} ev/s")
 
-    step = jax.jit(functools.partial(consensus_step_impl, cfg, "fast"))
+    from babble_tpu.ops.pallas_ingest import walk_supported
+
+    # Pallas walk ingest where the DAG fits its VMEM gates; XLA frontier
+    # path otherwise (identical outputs, differentially tested)
+    mode = "walk" if walk_supported(cfg.n, cfg.e_cap, cfg.s_cap) else "fast"
+    log(f"[{n}x{e}] ingest mode: {mode}")
+    step = jax.jit(functools.partial(consensus_step_impl, cfg, mode))
     t0 = time.perf_counter()
     out = step(init_state(cfg), batch)
     _ = np.asarray(out.cts[:1])   # hard sync (tunneled backends)

@@ -164,3 +164,25 @@ def test_cpp_baseline_matches_tpu_engine(n, e, seed):
             s = int(wslot[r, j])
             if 0 <= s < e:
                 assert base["fame"][s] == famous[r, j], (r, j, s)
+
+
+def test_walk_mode_matches_fast():
+    """The Pallas sequential-walk ingest (interpret mode on CPU) must be
+    bit-identical to the XLA frontier path."""
+    import jax
+
+    from babble_tpu.ops.pallas_ingest import walk_supported
+    from babble_tpu.ops.state import (
+        DagConfig, assert_consensus_parity, init_state,
+    )
+    from babble_tpu.parallel.sharded import consensus_step_impl
+    from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
+    n, e = 8, 1024
+    dag = random_gossip_arrays(n, e, seed=13)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=max(64, dag.max_chain + 1), r_cap=64)
+    assert walk_supported(cfg.n, cfg.e_cap, cfg.s_cap)
+    fast = jax.jit(lambda b: consensus_step_impl(cfg, "fast", init_state(cfg), b))(batch)
+    walk = jax.jit(lambda b: consensus_step_impl(cfg, "walk", init_state(cfg), b))(batch)
+    assert_consensus_parity(fast, walk, e, "walk-vs-fast")
