@@ -37,6 +37,20 @@ def cmd_keygen(args) -> int:
 async def _run_node(args) -> int:
     import os
 
+    # Persistent jit cache, shared by every node under one testnet root:
+    # live gossip produces a spread of bucketed batch shapes, and without
+    # the cache each (kpad, tpad, bpad) combination costs a fresh multi-
+    # second XLA compile on every node, every run — a compile storm that
+    # dominates fleet throughput.
+    if args.jax_cache != "off":
+        import jax
+
+        cache_dir = args.jax_cache or os.path.join(
+            os.path.abspath(args.datadir), "jax_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
     from .crypto.keys import PemKeyFile
     from .net.peers import JSONPeers
     from .net.tcp_transport import new_tcp_transport
@@ -63,6 +77,8 @@ async def _run_node(args) -> int:
         heartbeat=args.heartbeat / 1000.0,
         tcp_timeout=args.tcp_timeout / 1000.0,
         cache_size=args.cache_size,
+        consensus_interval=args.consensus_interval / 1000.0,
+        seq_window=args.seq_window or None,
     )
     conf.logger.setLevel(args.log_level.upper())
 
@@ -298,6 +314,12 @@ def main(argv=None) -> int:
     rn.add_argument("--max_pool", type=int, default=2)
     rn.add_argument("--tcp_timeout", type=int, default=1000, help="ms")
     rn.add_argument("--cache_size", type=int, default=500)
+    rn.add_argument("--consensus_interval", type=int, default=0,
+                    help="ms between consensus pipeline runs (0 = every sync)")
+    rn.add_argument("--seq_window", type=int, default=0,
+                    help="per-creator rolling window (0 = cache_size)")
+    rn.add_argument("--jax_cache", default="",
+                    help="jit cache dir ('' = <datadir>/../jax_cache, 'off' = disabled)")
     rn.add_argument("--checkpoint_dir", default="",
                     help="resume from + periodically checkpoint to this dir")
     rn.add_argument("--checkpoint_interval", type=float, default=30.0,

@@ -23,6 +23,24 @@ class Config:
     heartbeat: float = 1.0          # seconds (reference default 1000ms)
     tcp_timeout: float = 1.0        # seconds
     cache_size: int = 500           # engine event capacity hint
+    # Consensus cadence: 0 = run the pipeline after every sync (reference
+    # node.go:224 behavior, whose per-sync cost is microseconds).  The
+    # batched engine has a fixed per-call dispatch floor, so under fast
+    # gossip a positive interval amortizes many syncs into one device
+    # pipeline call — more events per kernel launch, and the core lock
+    # stays free for serving peers.
+    consensus_interval: float = 0.0  # seconds between pipeline runs
+    # Outbound gossip backpressure: the heartbeat keeps ticking regardless
+    # of how long syncs take (reference node.go:127-133), so without a cap
+    # a slow patch floods the fleet with queued sync tasks whose timeouts
+    # then read as failures.  The reference never hits this (its per-sync
+    # work is microseconds); with a batched engine it matters.
+    gossip_inflight: int = 4
+    # Per-creator rolling-window length (TooLate beyond it).  None = use
+    # cache_size, the reference's ParticipantEventsCache semantics; set it
+    # smaller to keep the device window (and therefore the jit shapes)
+    # fixed under sustained load — eviction then holds e_cap flat forever.
+    seq_window: int | None = None
     logger: logging.Logger = field(default_factory=_default_logger)
 
     @classmethod

@@ -27,6 +27,7 @@ class Core:
         engine: Optional[TpuHashgraph] = None,
         e_cap: int = 4096,
         cache_size: Optional[int] = None,
+        seq_window: Optional[int] = None,
     ):
         self.id = core_id
         self.key = key
@@ -39,7 +40,7 @@ class Core:
         self.hg = engine or TpuHashgraph(
             participants, commit_callback=commit_callback, e_cap=e_cap,
             auto_compact=bool(cache_size),   # 0/None = unbounded history
-            seq_window=cache_size or 256,
+            seq_window=seq_window or cache_size or 256,
             consensus_window=2 * cache_size if cache_size else None,
         )
         self.head: str = ""

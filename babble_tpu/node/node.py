@@ -55,6 +55,7 @@ class Node:
             commit_callback=None, engine=engine,
             e_cap=max(conf.cache_size, 64),
             cache_size=conf.cache_size,
+            seq_window=conf.seq_window,
         )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
@@ -74,6 +75,7 @@ class Node:
         # sync counters, node.go:64-65; here they are real)
         self.sync_requests = 0
         self.sync_errors = 0
+        self._last_consensus = 0.0
         self.start_time = time.monotonic()
         # last-gossip phase timings in ms (the reference logs ns durations
         # per phase, node.go:166-255, core.go:180-196; here they are part
@@ -131,11 +133,14 @@ class Node:
             if get_tx in done:
                 self.transaction_pool.append(get_tx.result())
             if gossip and _time.monotonic() >= deadline:
-                peer = self.peer_selector.next()
-                if peer is not None:
-                    t = asyncio.create_task(self._gossip(peer.net_addr))
-                    self._gossip_tasks.add(t)
-                    t.add_done_callback(self._gossip_tasks.discard)
+                # backpressure: never queue more in-flight syncs than the
+                # fleet can serve (Config.gossip_inflight)
+                if len(self._gossip_tasks) < self.conf.gossip_inflight:
+                    peer = self.peer_selector.next()
+                    if peer is not None:
+                        t = asyncio.create_task(self._gossip(peer.net_addr))
+                        self._gossip_tasks.add(t)
+                        t.add_done_callback(self._gossip_tasks.discard)
                 deadline = _time.monotonic() + self._random_timeout()
 
     def run_task(self, gossip: bool = True) -> asyncio.Task:
@@ -225,6 +230,20 @@ class Node:
                 self.transaction_pool = payload + self.transaction_pool
                 raise
             t1 = time.perf_counter()
+            # Consensus cadence (Config.consensus_interval): when gossip is
+            # faster than a device pipeline call, skip consensus here and
+            # let the next due sync batch everything inserted since — same
+            # total order, fewer/larger kernel launches, and the core lock
+            # stays available to serve peers.
+            interval = self.conf.consensus_interval
+            due = (
+                interval <= 0.0
+                or time.monotonic() - self._last_consensus >= interval
+            )
+            if not due:
+                self.timings = {**self.timings, "sync_ms": (t1 - t0) * 1e3}
+                return
+            self._last_consensus = time.monotonic()
             new_events, phase_timings = await loop.run_in_executor(
                 None, self.core.run_consensus
             )
