@@ -169,6 +169,37 @@ def _fd_incremental(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
     return state._replace(fd=jnp.minimum(state.fd, upd[:, : cfg.n]))
 
 
+def _fd_reverse_scan(
+    state: DagState, cfg: DagConfig, slot_sched: jnp.ndarray
+) -> DagState:
+    """First-descendant fill by reverse level scan — the mirror of the la
+    forward scan, for whole-DAG batches.
+
+    Walking levels deepest-first, every event's fd row is already final
+    (all its descendants live in deeper levels), so one scatter-min into
+    its parents' rows closes the recurrence:
+
+        fd[p] = elementwise-min over children c of fd[c], plus own seq
+
+    Cost is O(E·N) like the la scan (~0.8 s at 1M events) — it replaces
+    the chain-view compare-count (_fd_full) on the batch path, whose
+    O(N²·S²) = O(E²) blow-up costs ~12 s at 1M.  Requires the schedule to
+    cover the whole DAG (the 'fast'/'walk' batch modes); incremental and
+    engine paths keep their own fd strategies."""
+    def step(fd, idx):
+        rows = fd[idx]                                        # [B, N]
+        spx = sanitize(state.sp[idx], cfg.e_cap)
+        opx = sanitize(state.op[idx], cfg.e_cap)
+        fd = fd.at[spx].min(rows)
+        fd = fd.at[opx].min(rows)
+        return fd, None
+
+    fd, _ = jax.lax.scan(step, state.fd, slot_sched[::-1])
+    # pad lanes dumped mins into the sentinel row; restore it
+    e_row = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
+    return state._replace(fd=set_sentinel(fd, e_row, INT32_MAX))
+
+
 def _fd_full(state: DagState, cfg: DagConfig) -> DagState:
     """Full first-descendant recompute via chain-view searchsorted.
 
@@ -485,6 +516,17 @@ def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
       by 'walk'.
     """
     state = _write_batch_fields(state, cfg, batch)
+
+    def _fd_batch(state, slot_sched):
+        # Measured cost model (v5e): the reverse scan pays ~25 us per
+        # level step; the chain-view compare-count pays ~E^2 / 3e10 s.
+        # Deep narrow DAGs (64x65k: 3,494 levels) favor the count; wide
+        # ones (1024x100k: 392 levels; 256x1M) favor the scan by up to
+        # 12x.  Both are bit-identical (differentially tested).
+        if batch.sched.shape[0] < (cfg.e_cap ** 2) * 4.8e-7:
+            return _fd_reverse_scan(state, cfg, slot_sched)
+        return _fd_full(state, cfg)
+
     if fd_mode == "walk":
         from .pallas_ingest import la_walk, unpack_la, walk_supported
 
@@ -498,7 +540,8 @@ def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
             la=unpack_la(cfg.e_cap, cfg.n, packed, state.n_events)
         )
         state = _fd_init_own(state, cfg, batch)
-        state = _fd_full(state, cfg)
+        slot_sched = _slot_sched(state.n_events - batch.k, cfg, batch.sched)
+        state = _fd_batch(state, slot_sched)
         state = _rounds_frontier(state, cfg)
         return _reset_event_sentinels(state, cfg)
     if fd_mode == "absorb":
@@ -515,10 +558,13 @@ def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
         state = _fd_incremental(state, cfg, batch)
         state = _rounds_level_scan(state, cfg, slot_sched, batch.sched)
         return _reset_event_sentinels(state, cfg)
-    state = _fd_full(state, cfg)
     if fd_mode == "fast":
+        # batch path: the schedule covers the whole DAG, so the cheaper
+        # of reverse scan / compare-count applies (see _fd_batch)
+        state = _fd_batch(state, slot_sched)
         state = _rounds_frontier(state, cfg)
     else:
+        state = _fd_full(state, cfg)
         state = _rounds_level_scan(state, cfg, slot_sched, batch.sched)
     return _reset_event_sentinels(state, cfg)
 

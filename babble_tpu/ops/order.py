@@ -84,9 +84,11 @@ def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
     # fd values are absolute seqs; the grid columns are window-local
     fdc = jnp.clip(state.fd - state.s_off[None, :n], 0, cfg.s_cap)
 
-    if jax.default_backend() == "tpu":
-        # TPU: per-element gathers scalarize (~20 ns each) — resolve the
-        # lookup as an S-step select-accumulate, pure vectorized VPU work
+    if jax.default_backend() == "tpu" and cfg.s_cap < 2048:
+        # TPU, short chains: per-element gathers scalarize (~26 ns each),
+        # so an S-step select-accumulate in vectorized VPU work wins
+        # (measured 0.5 s vs 3.1 s at 1024x100k S=131; still ahead by
+        # ~60 ms at 64x65k S=1107)
         def acc_step(s, acc):
             return jnp.where(fdc == s, ts_grid[:, s][None, :], acc)
 
@@ -95,8 +97,8 @@ def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
             jnp.full((e1, n), INT64_MAX, dtype=state.ts.dtype),
         )
     else:
-        # CPU (live subprocess nodes): a real gather beats s_cap
-        # sequential steps by ~2 orders of magnitude
+        # long chains (select cost scales with S: 34.7 s vs 6.7 s at
+        # 256x1M, S=4106) and CPU backends: the real gather wins
         tv = ts_grid[jnp.arange(n)[None, :], fdc]
     tv = jnp.where(sees_i, tv, INT64_MAX)
     tv_sorted = jnp.sort(tv, axis=1)

@@ -1,12 +1,26 @@
-"""HTTP /Stats endpoint (reference service/service.go:26-58).
+"""HTTP /Stats + /debug endpoints (reference service/service.go:26-58).
 
 A minimal asyncio HTTP server living in the node's event loop, returning
 ``node.get_stats()`` as JSON with the reference's stat-key schema.
+
+The reference piggy-backs Go pprof on the same listener (cmd/main.go:26,
+``import _ "net/http/pprof"``); the equivalents here are the profilers
+this runtime actually has:
+
+- ``/debug/trace?seconds=S&dir=D`` — capture a jax profiler trace
+  (device kernels + host timeline, viewable in xprof/tensorboard) of the
+  next S seconds of live operation.
+- ``/debug/profile?seconds=S``     — cProfile of the whole process for S
+  seconds, returned as pstats text (the CPU flame view).
+- ``/debug/stack``                 — instantaneous stack dump of every
+  thread (the pprof goroutine-dump analogue; first stop for stalls).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
+from urllib.parse import parse_qs, urlsplit
 
 from ..common.aserver import AsyncTcpServer
 
@@ -15,6 +29,7 @@ class Service:
     def __init__(self, bind_addr: str, node):
         self.node = node
         self._server = AsyncTcpServer(bind_addr, self._handle)
+        self._profiling = False
 
     @property
     def bind_addr(self) -> str:
@@ -23,24 +38,97 @@ class Service:
     async def start(self) -> None:
         await self._server.start()
 
+    async def _debug(self, path: str, query: dict) -> tuple:
+        try:
+            seconds = float(query.get("seconds", ["2"])[0])
+        except ValueError:
+            seconds = float("nan")
+        if not seconds == seconds:   # NaN (incl. unparsable input)
+            return b"bad seconds parameter", "400 Bad Request", "text/plain"
+        seconds = min(max(seconds, 0.1), 120.0)
+        if path == "/debug/stack":
+            import sys
+            import threading
+            import traceback
+
+            names = {t.ident: t.name for t in threading.enumerate()}
+            lines = []
+            for tid, frame in sys._current_frames().items():
+                lines.append(f"Thread {names.get(tid, '?')} ({tid}):")
+                lines.extend(traceback.format_stack(frame))
+            return "\n".join(lines).encode(), "200 OK", "text/plain"
+        if path == "/debug/profile":
+            if self._profiling:
+                return b"profiler already running", "409 Conflict", "text/plain"
+            import cProfile
+            import io
+            import pstats
+
+            self._profiling = True
+            prof = cProfile.Profile()
+            try:
+                prof.enable()
+                await asyncio.sleep(seconds)
+            finally:
+                # in the finally: a cancelled request must not leave the
+                # global profiler tracing the event loop forever
+                prof.disable()
+                self._profiling = False
+            buf = io.StringIO()
+            pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(60)
+            return buf.getvalue().encode(), "200 OK", "text/plain"
+        if path == "/debug/trace":
+            if self._profiling:
+                return b"profiler already running", "409 Conflict", "text/plain"
+            import tempfile
+
+            import jax
+
+            out_dir = query.get("dir", [""])[0] or tempfile.mkdtemp(
+                prefix="babble-trace-"
+            )
+            self._profiling = True
+            started = False
+            try:
+                jax.profiler.start_trace(out_dir)
+                started = True
+                await asyncio.sleep(seconds)
+            finally:
+                # only stop what actually started — a start_trace failure
+                # must not mask itself with 'no trace running' and wedge
+                # _profiling permanently
+                if started:
+                    jax.profiler.stop_trace()
+                self._profiling = False
+            body = json.dumps({"trace_dir": out_dir, "seconds": seconds})
+            return body.encode(), "200 OK", "application/json"
+        return b'{"error": "not found"}', "404 Not Found", "application/json"
+
     async def _handle(self, reader, writer) -> None:
         request_line = await reader.readline()
         parts = request_line.decode(errors="replace").split()
-        path = parts[1] if len(parts) >= 2 else "/"
+        raw_path = parts[1] if len(parts) >= 2 else "/"
         # drain headers
         while True:
             line = await reader.readline()
             if line in (b"\r\n", b"\n", b""):
                 break
-        if path.rstrip("/").lower() in ("/stats", ""):
+        split = urlsplit(raw_path)
+        path = split.path.rstrip("/") or "/stats"
+        ctype = "application/json"
+        if path.lower() == "/stats":
             body = json.dumps(self.node.get_stats()).encode()
             status = "200 OK"
+        elif path.startswith("/debug/"):
+            body, status, ctype = await self._debug(
+                path, parse_qs(split.query)
+            )
         else:
             body = b'{"error": "not found"}'
             status = "404 Not Found"
         writer.write(
             f"HTTP/1.1 {status}\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: {ctype}\r\n"
             f"Content-Length: {len(body)}\r\n"
             f"Connection: close\r\n\r\n".encode() + body
         )

@@ -159,6 +159,55 @@ def run_byzantine(n: int, e: int, r_cap: int) -> float:
     return eps
 
 
+def run_million(n: int = 256, e: int = 1_000_000) -> float:
+    """The 1M-event scale config (BASELINE north-star direction): whole
+    pipeline on one chip, event axis dense.  No same-machine C++ number —
+    the reference algorithm took 37.5 s for 100k events and scales
+    superlinearly, so a 1M run would take over an hour; the 100k-measured
+    ratio (~36x) is the comparable figure.  The 10k-participant variant
+    (la/fd at 10k x 1M = 80 GB) needs the event-axis sharding in
+    parallel/sharded.py spread over a v5e-8+ mesh — multi-host launch is
+    the remaining work, the layout already shards "ev"."""
+    import jax
+    import numpy as np
+
+    from babble_tpu.ops.state import DagConfig, init_state
+    from babble_tpu.parallel.sharded import consensus_step_impl
+    from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
+    t0 = time.perf_counter()
+    dag = random_gossip_arrays(n, e, seed=7)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 33, r_cap=512)
+    log(f"[1M {n}x{e}] host build {time.perf_counter()-t0:.1f}s; {cfg}")
+    step = jax.jit(
+        functools.partial(consensus_step_impl, cfg, "fast"),
+        donate_argnums=(0,),
+    )
+    t0 = time.perf_counter()
+    out = step(init_state(cfg), batch)
+    _ = np.asarray(out.cts[:1])
+    log(f"[1M {n}x{e}] compile + first run: {time.perf_counter()-t0:.1f}s")
+    rr = np.asarray(out.rr)[:e]
+    ordered = int((rr >= 0).sum())
+    log(f"[1M {n}x{e}] ordered {ordered}/{e}, lcr {int(out.lcr)}, "
+        f"max round {int(out.max_round)}")
+    assert ordered > 0, "1M DAG reached no consensus"
+    assert int(out.max_round) < cfg.r_cap - 1, "round capacity saturated"
+
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        out = step(init_state(cfg), batch)
+        _ = np.asarray(out.cts[:1])
+        times.append(time.perf_counter() - t0)
+    t = sorted(times)[len(times) // 2]
+    eps = ordered / t
+    log(f"[1M {n}x{e}] times: {[f'{x:.2f}' for x in times]} -> "
+        f"{eps:,.0f} ev/s ({t:.1f}s to full order)")
+    return eps
+
+
 def run_live(n: int = 4, measure_s: float = 30.0) -> dict:
     """Live-gossip throughput: a real n-node TCP fleet (subprocess nodes on
     CPU, 10 ms heartbeat — the reference's Docker-testnet shape whose
@@ -310,6 +359,10 @@ def main() -> None:
         log(f"[byz 1024x100000] {byz_eps:,.0f} ev/s")
     except Exception as e:  # never discard the measured headline metric
         log(f"[byz 1024x100000] FAILED: {e}")
+    try:
+        run_million()
+    except Exception as e:
+        log(f"[1M] FAILED: {e}")
     eps, vs = headline
     print(json.dumps({
         "metric": "consensus_events_per_sec_1024x100k",

@@ -185,3 +185,59 @@ def test_random_peer_selector_excludes_self_and_last():
     sel.update_last("a1")
     picks = {sel.next().net_addr for _ in range(50)}
     assert picks == {"a2"}
+
+
+def test_service_debug_endpoints():
+    """The pprof analogue on the service listener (reference piggy-backs Go
+    pprof on /debug, cmd/main.go:26): stack dump, cProfile window, and the
+    jax trace endpoint all answer on a live node."""
+    import json
+    import urllib.request
+
+    from babble_tpu.service.service import Service
+
+    async def go():
+        net = InmemNetwork()
+        key = generate_key()
+        t = net.transport()
+        peers = [Peer(net_addr=t.local_addr(), pub_key_hex=key.pub_hex)]
+        node = Node(Config.test_config(), key, peers, t, InmemAppProxy())
+        node.init()
+        svc = Service("127.0.0.1:0", node)
+        await svc.start()
+        base = f"http://{svc.bind_addr}"
+        loop = asyncio.get_running_loop()
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.status, r.read()
+
+        st, body = await loop.run_in_executor(None, get, base + "/Stats")
+        assert st == 200 and b"consensus_events" in body
+        st, body = await loop.run_in_executor(None, get, base + "/debug/stack")
+        assert st == 200 and b"Thread" in body
+        st, body = await loop.run_in_executor(
+            None, get, base + "/debug/profile?seconds=0.2"
+        )
+        assert st == 200 and b"cumulative" in body
+        st, body = await loop.run_in_executor(
+            None, get, base + "/debug/trace?seconds=0.2"
+        )
+        assert st == 200
+        assert json.loads(body)["trace_dir"]
+
+        def get_bad(url):
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        st = await loop.run_in_executor(
+            None, get_bad, base + "/debug/profile?seconds=abc"
+        )
+        assert st == 400
+        await svc.close()
+        await node.shutdown()
+
+    asyncio.run(go())
