@@ -53,3 +53,46 @@ class SyncResponse:
             head=head,
             events=[WireEvent.unpack(e) for e in events],
         )
+
+
+RPC_FAST_FORWARD = 1
+
+
+@dataclass
+class FastForwardRequest:
+    """Catch-up bootstrap request (no reference counterpart: the reference
+    has no recovery once a peer falls behind its rolling caches).  Sent
+    when a sync returns the too-late error; the responder ships a full
+    state snapshot (store.checkpoint.snapshot_bytes)."""
+
+    from_addr: str
+
+    def pack(self) -> bytes:
+        return msgpack.packb([self.from_addr], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "FastForwardRequest":
+        (from_addr,) = msgpack.unpackb(data, raw=False)
+        return cls(from_addr=from_addr)
+
+
+@dataclass
+class FastForwardResponse:
+    from_addr: str
+    snapshot: bytes
+
+    def pack(self) -> bytes:
+        return msgpack.packb([self.from_addr, self.snapshot], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "FastForwardResponse":
+        from_addr, snapshot = msgpack.unpackb(data, raw=False)
+        return cls(from_addr=from_addr, snapshot=snapshot)
+
+
+SyncRequest.RTYPE = RPC_SYNC
+SyncRequest.RESPONSE_CLS = SyncResponse
+FastForwardRequest.RTYPE = RPC_FAST_FORWARD
+FastForwardRequest.RESPONSE_CLS = FastForwardResponse
+
+REQUEST_TYPES = {RPC_SYNC: SyncRequest, RPC_FAST_FORWARD: FastForwardRequest}

@@ -14,7 +14,7 @@ import struct
 from typing import Dict, List, Optional
 
 from ..common.aserver import AsyncTcpServer
-from .commands import RPC_SYNC, SyncRequest, SyncResponse
+from .commands import REQUEST_TYPES, RPC_SYNC, SyncRequest, SyncResponse
 from .transport import RPC, Transport, TransportError
 
 _HDR = struct.Struct(">BI")
@@ -26,6 +26,13 @@ _RHDR = struct.Struct(">BI")
 # at 16 MB, proxy/jsonrpc.py).  Sync payloads are event diffs — far below
 # this in any honest configuration.
 MAX_FRAME = 16 * 1024 * 1024
+# fast-forward responses carry a whole compressed state window — allow
+# them more than gossip frames, still bounded
+MAX_FF_FRAME = 256 * 1024 * 1024
+
+
+def _frame_cap(rtype: int) -> int:
+    return MAX_FRAME if rtype == RPC_SYNC else MAX_FF_FRAME
 
 
 class FrameTooLarge(TransportError):
@@ -91,12 +98,13 @@ class TCPTransport(Transport):
                 writer.close()
                 return
             payload = await reader.readexactly(ln)
-            if rtype != RPC_SYNC:
+            req_cls = REQUEST_TYPES.get(rtype)
+            if req_cls is None:
                 writer.write(_RHDR.pack(1, 0) + b"")
                 await writer.drain()
                 continue
             try:
-                cmd = SyncRequest.unpack(payload)
+                cmd = req_cls.unpack(payload)
             except Exception:
                 # malformed payload: report an error frame and drop the
                 # connection (framing state is untrustworthy)
@@ -107,12 +115,23 @@ class TCPTransport(Transport):
                 return
             rpc = RPC(command=cmd)
             await self._consumer.put(rpc)
+            # snapshot serving (fast-forward) serializes a whole window
+            # under the core lock — give it real time, unlike syncs
+            wait = self.timeout if rtype == RPC_SYNC else max(
+                self.timeout, 30.0
+            )
             try:
-                resp = await asyncio.wait_for(rpc.response(), self.timeout)
+                resp = await asyncio.wait_for(rpc.response(), wait)
                 body = resp.pack()
+                if len(body) > _frame_cap(rtype):
+                    raise FrameTooLarge(
+                        f"{len(body)}-byte response exceeds the "
+                        f"{_frame_cap(rtype)}-byte frame cap (shrink the "
+                        f"window or raise the cap)"
+                    )
                 writer.write(_RHDR.pack(0, len(body)) + body)
             except Exception as e:  # handler error -> error frame
-                msg = str(e).encode()
+                msg = str(e).encode()[:4096]
                 writer.write(_RHDR.pack(1, len(msg)) + msg)
             await writer.drain()
 
@@ -140,6 +159,10 @@ class TCPTransport(Transport):
     async def sync(
         self, target: str, req: SyncRequest, timeout: Optional[float] = None
     ) -> SyncResponse:
+        return await self.request(target, req, timeout)
+
+    async def request(self, target, req, timeout: Optional[float] = None):
+        """Generic verb-tagged RPC (req.RTYPE / req.RESPONSE_CLS)."""
         if self._closed:
             raise TransportError("transport closed")
         timeout = timeout or self.timeout
@@ -147,20 +170,21 @@ class TCPTransport(Transport):
         reader, writer = conn
         try:
             body = req.pack()
-            writer.write(_HDR.pack(RPC_SYNC, len(body)) + body)
+            writer.write(_HDR.pack(req.RTYPE, len(body)) + body)
             await writer.drain()
             hdr = await asyncio.wait_for(
                 reader.readexactly(_RHDR.size), timeout
             )
             ok, ln = _RHDR.unpack(hdr)
-            if ln > MAX_FRAME:
+            if ln > _frame_cap(req.RTYPE):
                 raise FrameTooLarge(
-                    f"response frame of {ln} bytes exceeds {MAX_FRAME}"
+                    f"response frame of {ln} bytes exceeds "
+                    f"{_frame_cap(req.RTYPE)}"
                 )
             payload = await asyncio.wait_for(reader.readexactly(ln), timeout)
             if ok != 0:
                 raise TransportError(payload.decode(errors="replace"))
-            resp = SyncResponse.unpack(payload)
+            resp = req.RESPONSE_CLS.unpack(payload)
         except BaseException as e:
             # Any failure mid-RPC (I/O error, timeout, error frame, unpack
             # failure, cancellation) leaves the stream in an unknown state —

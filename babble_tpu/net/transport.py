@@ -54,5 +54,10 @@ class Transport:
         """Send a sync request to target and await its response."""
         raise NotImplementedError
 
+    async def request(self, target, req, timeout: Optional[float] = None):
+        """Generic verb-tagged RPC; defaults to the sync plumbing (in-
+        process transports pass request objects through unchanged)."""
+        return await self.sync(target, req, timeout)
+
     async def close(self) -> None:
         raise NotImplementedError

@@ -55,6 +55,33 @@ class Core:
 
     # ------------------------------------------------------------------
 
+    def bootstrap(self, engine: TpuHashgraph) -> None:
+        """Replace the consensus engine with a fast-forward snapshot (the
+        catch-up path, node.py): adopt the peer's windowed state and pick
+        our own chain back up from whatever the snapshot knows of us.
+
+        Validates before swapping so a bad snapshot can't leave the Core
+        half-migrated.  The eviction policy keeps every creator's last
+        seq_window events, so a non-empty chain always has a live tail;
+        an empty window despite a non-zero count means a corrupt snapshot."""
+        chain = engine.dag.chains[self.participants[self.pub_hex]]
+        if chain and not chain.window:
+            raise ValueError(
+                "snapshot window holds none of our own chain tail"
+            )
+        if chain:
+            head_ev = engine.dag.events[chain[-1]]
+            self.hg = engine
+            self.head = head_ev.hex()
+            self.seq = head_ev.index
+        else:
+            # the snapshot knows nothing of us (our pre-partition events
+            # never propagated): mint a fresh root so syncs have a head
+            self.hg = engine
+            self.head = ""
+            self.seq = -1
+            self.init()
+
     def init(self) -> None:
         """Create + insert the node's root event (reference core.go:79-97)."""
         ev = new_event([], ("", ""), self.key.pub_bytes, 0)

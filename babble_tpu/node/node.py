@@ -19,10 +19,16 @@ import random
 import time
 from typing import Dict, List, Optional
 
+from ..common import TooLateError
 from ..consensus.engine import TpuHashgraph
 from ..core.event import Event
 from ..crypto.keys import KeyPair
-from ..net.commands import SyncRequest, SyncResponse
+from ..net.commands import (
+    FastForwardRequest,
+    FastForwardResponse,
+    SyncRequest,
+    SyncResponse,
+)
 from ..net.peers import Peer, canonical_ids
 from ..net.transport import Transport, TransportError
 from .config import Config
@@ -76,6 +82,7 @@ class Node:
         self.sync_requests = 0
         self.sync_errors = 0
         self._last_consensus = 0.0
+        self._fast_forwarding = False
         self.start_time = time.monotonic()
         # last-gossip phase timings in ms (the reference logs ns durations
         # per phase, node.go:166-255, core.go:180-196; here they are part
@@ -166,8 +173,16 @@ class Node:
     async def _process_rpc(self, rpc) -> None:
         req = rpc.command
         try:
-            resp = await self._process_sync_request(req)
+            if isinstance(req, FastForwardRequest):
+                resp = await self._process_fast_forward_request(req)
+            else:
+                resp = await self._process_sync_request(req)
             rpc.respond(resp)
+        except TooLateError as e:
+            # structured marker: the requester's Known fell below our
+            # rolling window — it must fast-forward, not retry
+            self.logger.info("sync request too late: %s", e)
+            rpc.respond(None, error=f"too_late: {e}")
         except Exception as e:
             self.logger.warning("sync request failed: %s", e)
             rpc.respond(None, error=str(e))
@@ -186,6 +201,26 @@ class Node:
             wire, head = await loop.run_in_executor(None, work)
         return SyncResponse(
             from_addr=self.transport.local_addr(), head=head, events=wire
+        )
+
+    async def _process_fast_forward_request(
+        self, req: FastForwardRequest
+    ) -> FastForwardResponse:
+        """Serve a catch-up snapshot (no reference counterpart — a peer
+        behind the reference's rolling caches can never rejoin)."""
+        from ..store.checkpoint import snapshot_bytes
+
+        loop = asyncio.get_running_loop()
+        async with self.core_lock:
+            snap = await loop.run_in_executor(
+                None, snapshot_bytes, self.core.hg
+            )
+        self.logger.info(
+            "served fast-forward snapshot (%d bytes) to %s",
+            len(snap), req.from_addr,
+        )
+        return FastForwardResponse(
+            from_addr=self.transport.local_addr(), snapshot=snap
         )
 
     # ------------------------------------------------------------------
@@ -207,9 +242,79 @@ class Node:
             self.peer_selector.update_last(peer_addr)
         except asyncio.CancelledError:
             raise
+        except TransportError as e:
+            if str(e).startswith("too_late"):
+                # we fell behind the peer's rolling window: bootstrap from
+                # a snapshot instead of retrying a sync that can never work
+                await self._fast_forward(peer_addr)
+                return
+            self.sync_errors += 1
+            self.logger.warning("gossip to %s failed: %s", peer_addr, e)
         except Exception as e:  # any failure counts against sync_rate
             self.sync_errors += 1
             self.logger.warning("gossip to %s failed: %s", peer_addr, e)
+
+    async def _fast_forward(self, peer_addr: str) -> None:
+        """Catch-up: fetch a snapshot and restart consensus from it.
+
+        Trust model: event signatures in the snapshot are re-verified;
+        the consensus decisions ride on trust in the serving peer (the
+        babbleio fast-sync assumption — signed state proofs are the
+        known hardening).  Pooled transactions survive the swap and ride
+        the next self-event."""
+        from ..store.checkpoint import load_snapshot
+
+        if self._fast_forwarding:
+            return
+        self._fast_forwarding = True
+        try:
+            resp = await self.transport.request(
+                peer_addr,
+                FastForwardRequest(from_addr=self.transport.local_addr()),
+                timeout=max(self.conf.tcp_timeout, 30.0),
+            )
+            # local policy overrides whatever the peer serialized — a
+            # snapshot must not disable our signature checks or replace
+            # our memory bounds
+            cs = self.conf.cache_size
+            policy = {
+                "verify_signatures": True,
+                "auto_compact": bool(cs),
+                "seq_window": self.conf.seq_window or cs or 256,
+                "consensus_window": 2 * cs if cs else None,
+            }
+            loop = asyncio.get_running_loop()
+            async with self.core_lock:
+                engine = await loop.run_in_executor(
+                    None,
+                    lambda: load_snapshot(resp.snapshot, policy=policy),
+                )
+                self.core.bootstrap(engine)
+            self.logger.warning(
+                "fast-forwarded from %s: %d events in window, lcr=%s",
+                peer_addr,
+                engine.dag.n_events - engine.dag.slot_base,
+                engine._lcr_cache,
+            )
+            # The app missed every commit between its last delivery and
+            # the snapshot cursor — surface the gap so state-machine apps
+            # can restore from their own snapshot (the babbleio fast-sync
+            # Snapshot/Restore seam; InmemAppProxy just records it).
+            on_gap = getattr(self.proxy, "on_fast_forward", None)
+            if on_gap is not None:
+                try:
+                    await on_gap(engine._lcr_cache)
+                except Exception as e:
+                    self.logger.warning(
+                        "app fast-forward hook failed: %s", e
+                    )
+        except Exception as e:
+            self.sync_errors += 1
+            self.logger.warning(
+                "fast-forward from %s failed: %s", peer_addr, e
+            )
+        finally:
+            self._fast_forwarding = False
 
     async def _process_sync_response(self, resp: SyncResponse) -> None:
         loop = asyncio.get_running_loop()

@@ -13,6 +13,7 @@ class InmemAppProxy:
     def __init__(self):
         self.submit_queue: "asyncio.Queue[bytes]" = asyncio.Queue()
         self.committed: List[bytes] = []
+        self.fast_forwards: List[int] = []
 
     async def submit_tx(self, tx: bytes) -> None:
         await self.submit_queue.put(bytes(tx))
@@ -25,3 +26,9 @@ class InmemAppProxy:
 
     def committed_transactions(self) -> List[bytes]:
         return list(self.committed)
+
+    async def on_fast_forward(self, lcr) -> None:
+        """Fast-forward gap notification (node catch-up): commits between
+        the last delivery and round `lcr` were skipped; a state-machine
+        app would restore its own snapshot here."""
+        self.fast_forwards.append(lcr)

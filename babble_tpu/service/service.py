@@ -10,8 +10,9 @@ this runtime actually has:
 - ``/debug/trace?seconds=S&dir=D`` — capture a jax profiler trace
   (device kernels + host timeline, viewable in xprof/tensorboard) of the
   next S seconds of live operation.
-- ``/debug/profile?seconds=S``     — cProfile of the whole process for S
-  seconds, returned as pstats text (the CPU flame view).
+- ``/debug/profile?seconds=S``     — cProfile of the event-loop thread
+  for S seconds, returned as pstats text (executor threads — the device
+  dispatch path — need the jax trace above instead).
 - ``/debug/stack``                 — instantaneous stack dump of every
   thread (the pprof goroutine-dump analogue; first stop for stalls).
 """
@@ -84,9 +85,10 @@ class Service:
 
             import jax
 
-            out_dir = query.get("dir", [""])[0] or tempfile.mkdtemp(
-                prefix="babble-trace-"
-            )
+            # always a fresh private tempdir: the listener is
+            # unauthenticated, so a caller-chosen path would be an
+            # arbitrary-filesystem-write primitive
+            out_dir = tempfile.mkdtemp(prefix="babble-trace-")
             self._profiling = True
             started = False
             try:

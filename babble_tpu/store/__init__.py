@@ -7,10 +7,12 @@ coordinate tensors) is managed by ``babble_tpu.consensus.engine`` and
 checkpointed via ``babble_tpu.store.checkpoint``.
 """
 
-from .checkpoint import load_checkpoint, save_checkpoint
+from .checkpoint import (
+    load_checkpoint, load_snapshot, save_checkpoint, snapshot_bytes,
+)
 from .inmem import InmemStore, RoundEvent, RoundInfo, Store
 
 __all__ = [
     "Store", "InmemStore", "RoundInfo", "RoundEvent",
-    "save_checkpoint", "load_checkpoint",
+    "save_checkpoint", "load_checkpoint", "snapshot_bytes", "load_snapshot",
 ]

@@ -64,12 +64,9 @@ def _unpack_event(obj: list) -> Event:
     )
 
 
-def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
-    """Write a consistent snapshot of `engine` to directory `path`."""
-    engine.flush()  # device state must reflect every inserted event
-
+def _build_meta(engine: TpuHashgraph) -> dict:
     dag = engine.dag
-    meta = {
+    return {
         "version": FORMAT_VERSION,
         "participants": sorted(engine.participants.items()),
         "cfg": list(engine.cfg),
@@ -92,10 +89,20 @@ def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
         "received": sorted(engine._received),
     }
 
-    arrays = {
+
+def _build_arrays(engine: TpuHashgraph) -> Dict[str, np.ndarray]:
+    return {
         name: np.asarray(getattr(engine.state, name))
         for name in DagState._fields
     }
+
+
+def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
+    """Write a consistent snapshot of `engine` to directory `path`."""
+    engine.flush()  # device state must reflect every inserted event
+
+    meta = _build_meta(engine)
+    arrays = _build_arrays(engine)
 
     tmp = tempfile.mkdtemp(dir=os.path.dirname(os.path.abspath(path)) or ".")
     try:
@@ -114,6 +121,54 @@ def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
         raise
 
 
+def snapshot_bytes(engine: TpuHashgraph) -> bytes:
+    """Serialize a consistent snapshot to bytes — the fast-forward wire
+    payload (node/node.py): what save_checkpoint writes as files, packed
+    as one msgpack pair [meta, compressed-npz]."""
+    import io
+
+    engine.flush()
+    meta = _build_meta(engine)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **_build_arrays(engine))
+    return msgpack.packb(
+        [msgpack.packb(meta, use_bin_type=True), buf.getvalue()],
+        use_bin_type=True,
+    )
+
+
+def load_snapshot(
+    data: bytes,
+    commit_callback: Optional[Callable] = None,
+    verify_events: bool = True,
+    policy: Optional[dict] = None,
+) -> TpuHashgraph:
+    """Reconstruct an engine from snapshot bytes (the fast-forward
+    bootstrap).  The snapshot comes from a *peer*, so every event
+    signature in the window is re-verified by default, and the LOCAL
+    node's policy knobs (``policy``: verify_signatures, auto_compact,
+    seq_window, compact_min, consensus_window, round_margin) override
+    whatever the peer serialized — a snapshot must never be able to turn
+    our signature checks off or replace our memory bounds.  The consensus
+    fields (rounds, fame, order) are taken on trust from the serving peer
+    — the same trust-on-catch-up assumption babbleio's fast-sync makes,
+    pending signed state proofs."""
+    import io
+
+    meta_b, npz_b = msgpack.unpackb(data, raw=False)
+    meta = msgpack.unpackb(meta_b, raw=False, strict_map_key=False)
+    with np.load(io.BytesIO(npz_b)) as z:
+        arrays = {name: z[name] for name in DagState._fields}
+    engine = _restore_engine(meta, arrays, commit_callback, policy)
+    if verify_events:
+        for ev in engine.dag.events:
+            if not ev.verify():
+                raise ValueError(
+                    f"snapshot event {ev.hex()[:18]}… has a bad signature"
+                )
+    return engine
+
+
 def load_checkpoint(
     path: str,
     commit_callback: Optional[Callable] = None,
@@ -121,10 +176,25 @@ def load_checkpoint(
     """Reconstruct an engine from a checkpoint directory."""
     with open(os.path.join(path, _META), "rb") as f:
         meta = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+    with np.load(os.path.join(path, _DEVICE)) as z:
+        arrays = {name: z[name] for name in DagState._fields}
+    return _restore_engine(meta, arrays, commit_callback)
+
+
+def _restore_engine(
+    meta: dict,
+    arrays: Dict[str, np.ndarray],
+    commit_callback: Optional[Callable] = None,
+    policy: Optional[dict] = None,
+) -> TpuHashgraph:
     if meta["version"] != FORMAT_VERSION:
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
+    policy = policy or {}
 
     participants: Dict[str, int] = {k: int(v) for k, v in meta["participants"]}
+    # capacities are shape facts of the serialized arrays; policy knobs
+    # come from the snapshot for local checkpoints but are overridden by
+    # the local node's values on the network path (load_snapshot)
     cfg = DagConfig(*meta["cfg"])
     auto_compact, seq_window, round_margin, compact_min, cons_window = (
         meta["policy"]
@@ -132,11 +202,15 @@ def load_checkpoint(
     engine = TpuHashgraph(
         participants,
         commit_callback=commit_callback,
-        verify_signatures=meta["verify_signatures"],
+        verify_signatures=policy.get(
+            "verify_signatures", meta["verify_signatures"]
+        ),
         e_cap=cfg.e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
-        auto_compact=auto_compact, seq_window=seq_window,
-        round_margin=round_margin, compact_min=compact_min,
-        consensus_window=cons_window,
+        auto_compact=policy.get("auto_compact", auto_compact),
+        seq_window=policy.get("seq_window", seq_window),
+        round_margin=policy.get("round_margin", round_margin),
+        compact_min=policy.get("compact_min", compact_min),
+        consensus_window=policy.get("consensus_window", cons_window),
     )
     engine.cfg = cfg
 
@@ -163,10 +237,9 @@ def load_checkpoint(
 
     import jax.numpy as jnp
 
-    with np.load(os.path.join(path, _DEVICE)) as z:
-        engine.state = DagState(
-            **{name: jnp.asarray(z[name]) for name in DagState._fields}
-        )
+    engine.state = DagState(
+        **{name: jnp.asarray(arrays[name]) for name in DagState._fields}
+    )
 
     cons_start, cons_items = meta["consensus"]
     engine.consensus = OffsetList(cons_items, cons_start)

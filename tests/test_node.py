@@ -241,3 +241,87 @@ def test_service_debug_endpoints():
         await node.shutdown()
 
     asyncio.run(go())
+
+
+def test_fast_forward_rejoins_evicted_window():
+    """A node whose Known falls below a peer's rolling window must catch up
+    via the snapshot RPC and then keep committing alongside the fleet —
+    the recovery the reference lacks entirely (a peer behind its rolling
+    caches can never rejoin)."""
+
+    async def go():
+        # 4 participants: the 3 connected nodes still form a supermajority
+        # (2n/3+1 = 3), so consensus + eviction proceed while one is down
+        n = 4
+        keys = sorted(
+            [generate_key() for _ in range(n)], key=lambda k: k.pub_hex
+        )
+        peers_conf = []
+        net = InmemNetwork()
+        transports = [net.transport(f"inmem://{i}") for i in range(n)]
+        for i, k in enumerate(keys):
+            peers_conf.append(
+                Peer(net_addr=transports[i].local_addr(), pub_key_hex=k.pub_hex)
+            )
+        # aggressive windows so eviction happens fast
+        def conf():
+            c = Config.test_config(heartbeat=0.01)
+            c.cache_size = 64
+            c.seq_window = 8
+            return c
+
+        proxies = [InmemAppProxy() for _ in range(n)]
+        nodes = [
+            Node(conf(), keys[i], peers_conf, transports[i], proxies[i])
+            for i in range(n)
+        ]
+        for nd in nodes:
+            nd.init()
+
+        # partition the last node before it learns anything beyond roots
+        straggler = n - 1
+        net.disconnect_all(transports[straggler].local_addr())
+        for nd in nodes[:straggler]:
+            nd.run_task()
+
+        # run the majority until they evicted past the straggler's Known
+        deadline = asyncio.get_event_loop().time() + 120
+        while asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.5)
+            if all(nd.core.hg.dag.slot_base > 8 for nd in nodes[:straggler]):
+                break
+        assert all(
+            nd.core.hg.dag.slot_base > 8 for nd in nodes[:straggler]
+        ), "majority never evicted"
+
+        # reconnect: the straggler's first syncs get too_late -> fast-forward
+        for other in range(n):
+            net.connect(transports[straggler].local_addr(),
+                        transports[other].local_addr())
+            net.connect(transports[other].local_addr(),
+                        transports[straggler].local_addr())
+        nodes[straggler].run_task()
+
+        deadline = asyncio.get_event_loop().time() + 120
+        ffed = False
+        while asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.5)
+            if nodes[straggler].core.hg.dag.slot_base > 0:
+                ffed = True
+                break
+        assert ffed, "straggler never fast-forwarded"
+
+        # and it must now make progress with the fleet
+        base = nodes[straggler].core.hg.consensus_events_count()
+        deadline = asyncio.get_event_loop().time() + 120
+        while asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.5)
+            if nodes[straggler].core.hg.consensus_events_count() > base + 20:
+                break
+        assert nodes[straggler].core.hg.consensus_events_count() > base + 20, (
+            "rejoined node made no progress"
+        )
+        for nd in nodes:
+            await nd.shutdown()
+
+    asyncio.run(go())
