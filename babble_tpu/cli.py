@@ -278,6 +278,42 @@ def cmd_testnet(args) -> int:
     raise SystemExit(f"unknown testnet subcommand {args.testnet_cmd}")
 
 
+def cmd_fleet(args) -> int:
+    from . import fleet as fl
+    from . import testnet as tn
+
+    with open(args.hosts) as f:
+        hosts = [ln.strip() for ln in f if ln.strip()]
+    if not hosts:
+        raise SystemExit(f"{args.hosts} lists no hosts")
+    if getattr(args, "rate", 1.0) <= 0:
+        raise SystemExit("--rate must be positive")
+    layout = fl.HostLayout(
+        hosts, gossip_port=args.gossip_port, submit_port=args.submit_port,
+        commit_port=args.commit_port, service_port=args.service_port,
+    )
+    if args.fleet_cmd == "conf":
+        dirs = fl.build_fleet_conf(
+            __import__("os").path.join(args.dir, "conf"), layout
+        )
+        scripts = fl.write_deploy_scripts(args.dir, layout)
+        print(f"wrote {len(dirs)} node configs + "
+              f"{len(scripts)} deploy files under {args.dir}")
+        return 0
+    if args.fleet_cmd == "watch":
+        while True:
+            print("\x1b[2J\x1b[H" + tn.format_stats(fl.watch_hosts(layout)))
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    if args.fleet_cmd == "bombard":
+        sent = asyncio.run(
+            fl.bombard_hosts(layout, args.rate, args.duration))
+        print(f"submitted {sent} transactions")
+        return 0
+    raise SystemExit(f"unknown fleet subcommand {args.fleet_cmd}")
+
+
 def main(argv=None) -> int:
     import os
 
@@ -370,6 +406,30 @@ def main(argv=None) -> int:
             sp.add_argument("--rate", type=float, default=50.0, help="tx/s")
             sp.add_argument("--duration", type=float, default=10.0)
         sp.set_defaults(fn=cmd_testnet)
+
+    flp = sub.add_parser("fleet", help="multi-host fleet ops "
+                         "(reference terraform/makefile + scripts)")
+    fsub = flp.add_subparsers(dest="fleet_cmd", required=True)
+    for name, hlp in (
+        ("conf", "node datadirs + peers.json + ssh deploy scripts"),
+        ("watch", "poll every host's /Stats"),
+        ("bombard", "flood transactions across the hosts"),
+    ):
+        sp = fsub.add_parser(name, help=hlp)
+        sp.add_argument("--hosts", required=True,
+                        help="file with one routable host address per line")
+        sp.add_argument("--dir", default="fleet-data")
+        sp.add_argument("--gossip_port", type=int, default=1337)
+        sp.add_argument("--submit_port", type=int, default=1338)
+        sp.add_argument("--commit_port", type=int, default=1339)
+        sp.add_argument("--service_port", type=int, default=8080)
+        if name == "watch":
+            sp.add_argument("--interval", type=float, default=2.0)
+            sp.add_argument("--once", action="store_true")
+        if name == "bombard":
+            sp.add_argument("--rate", type=float, default=50.0, help="tx/s")
+            sp.add_argument("--duration", type=float, default=10.0)
+        sp.set_defaults(fn=cmd_fleet)
 
     args = p.parse_args(argv)
     return args.fn(args)

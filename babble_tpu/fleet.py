@@ -1,0 +1,235 @@
+"""Multi-host fleet ops — the cloud/terraform workflow, rebuilt as code.
+
+The reference drives cloud testnets with Terraform plus ssh shell
+(reference terraform/makefile:1-34, terraform/scripts/build-conf.sh,
+remote-run.sh, remote-kill.sh, watch.sh, bombard.sh): provision hosts,
+generate per-node datadirs against the hosts' private IPs, push, start
+over ssh, watch /Stats, bombard.  Provisioning belongs to whatever IaC
+the operator runs; everything after the host list exists is here:
+
+- ``build_fleet_conf`` — datadirs keyed to real host addresses
+  (terraform/scripts/build-conf.sh)
+- ``write_deploy_scripts`` — push/start/stop ssh scripts + a makefile
+  mirroring the reference verbs (remote-run.sh / remote-kill.sh /
+  makefile)
+- ``watch_hosts`` / ``bombard_hosts`` — the fleet-wide /Stats sweep and
+  transaction flood against arbitrary addresses (watch.sh / bombard.sh)
+
+``babble-tpu fleet`` on the CLI fronts all of it.  The single-host
+subprocess variant lives in testnet.py.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .crypto.keys import PemKeyFile, generate_key
+from .net.peers import JSONPeers, Peer
+from .testnet import fetch_stats
+
+GOSSIP_PORT = 1337   # the reference's conventional ports
+SUBMIT_PORT = 1338   # (terraform/scripts/remote-run.sh:12-19)
+COMMIT_PORT = 1339
+SERVICE_PORT = 8080
+
+
+@dataclass
+class HostLayout:
+    """One node per host, reference port conventions."""
+
+    hosts: List[str]                 # routable addresses, one per node
+    gossip_port: int = GOSSIP_PORT
+    submit_port: int = SUBMIT_PORT
+    commit_port: int = COMMIT_PORT
+    service_port: int = SERVICE_PORT
+
+    def of(self, i: int) -> Dict[str, str]:
+        h = self.hosts[i]
+        return {
+            "gossip": f"{h}:{self.gossip_port}",
+            "submit": f"{h}:{self.submit_port}",
+            "commit": f"{h}:{self.commit_port}",
+            "service": f"{h}:{self.service_port}",
+        }
+
+
+def build_fleet_conf(base_dir: str, layout: HostLayout) -> List[str]:
+    """Per-host datadirs (key + shared peers.json) against the hosts'
+    routable addresses (terraform/scripts/build-conf.sh)."""
+    datadirs = []
+    keys = []
+    for i, _ in enumerate(layout.hosts):
+        d = os.path.join(base_dir, f"node{i}")
+        os.makedirs(d, exist_ok=True)
+        pem = PemKeyFile(d)
+        keys.append(pem.read() if pem.exists() else generate_key())
+        if not pem.exists():
+            pem.write(keys[-1])
+        datadirs.append(d)
+    peers = [
+        Peer(net_addr=layout.of(i)["gossip"], pub_key_hex=keys[i].pub_hex)
+        for i in range(len(layout.hosts))
+    ]
+    for d in datadirs:
+        JSONPeers(d).set_peers(peers)
+    return datadirs
+
+
+_START_SH = """#!/bin/bash
+# start node $2 on host $1 (terraform/scripts/remote-run.sh analogue)
+set -eu
+host=$1; i=$2
+ssh ${SSH_OPTS:-} "${SSH_USER:-$USER}@${host}" <<-EOF
+    cd __REMOTE_DIR__
+    nohup __PYTHON__ -m babble_tpu.cli run \\
+        --datadir conf/node${i} \\
+        --node_addr ${host}:__GOSSIP__ \\
+        --proxy_addr 0.0.0.0:__SUBMIT__ \\
+        --client_addr ${host}:__COMMIT__ \\
+        --service_addr 0.0.0.0:__SERVICE__ \\
+        --heartbeat __HEARTBEAT__ --tcp_timeout __TCP_TIMEOUT__ \\
+        --cache_size __CACHE__ --seq_window __SEQ_WINDOW__ \\
+        --consensus_interval __CONSENSUS_INTERVAL__ \\
+        --no_client --log_level warning \\
+        > node${i}.log 2>&1 &
+EOF
+"""
+
+_STOP_SH = """#!/bin/bash
+# stop the node on host $1 (terraform/scripts/remote-kill.sh analogue)
+set -eu
+host=$1
+ssh ${SSH_OPTS:-} "${SSH_USER:-$USER}@${host}" \\
+    "pkill -f 'babble_tpu.cli run' || true"
+"""
+
+_PUSH_SH = """#!/bin/bash
+# ship the package + this node's conf to host $1 (index $2)
+set -eu
+host=$1; i=$2
+ssh ${SSH_OPTS:-} "${SSH_USER:-$USER}@${host}" "mkdir -p __REMOTE_DIR__/conf"
+scp ${SSH_OPTS:-} -r __PACKAGE_DIR__ \\
+    "${SSH_USER:-$USER}@${host}:__REMOTE_DIR__/babble_tpu"
+scp ${SSH_OPTS:-} -r conf/node${i} \\
+    "${SSH_USER:-$USER}@${host}:__REMOTE_DIR__/conf/"
+"""
+
+_MAKEFILE = """# fleet driver (reference terraform/makefile verbs)
+HOSTS ?= hosts.txt
+
+conf:
+\t__PYTHON__ -m babble_tpu.cli fleet conf --hosts $(HOSTS) --dir .
+
+push:
+\tawk '{system("./push.sh "$$1" "NR-1)}' $(HOSTS)
+
+start:
+\tawk '{system("./start.sh "$$1" "NR-1)}' $(HOSTS)
+
+watch:
+\t__PYTHON__ -m babble_tpu.cli fleet watch --hosts $(HOSTS)
+
+bombard:
+\t__PYTHON__ -m babble_tpu.cli fleet bombard --hosts $(HOSTS) --rate 100 --duration 10
+
+stop:
+\tawk '{system("./stop.sh "$$1)}' $(HOSTS)
+"""
+
+
+def write_deploy_scripts(
+    base_dir: str,
+    layout: HostLayout,
+    remote_dir: str = "~/babble-tpu",
+    python: str = "python3",
+    heartbeat_ms: int = 50,
+    tcp_timeout_ms: int = 1000,
+    cache_size: int = 4096,
+    seq_window: int = 256,
+    consensus_interval_ms: int = 250,
+) -> List[str]:
+    """Emit push/start/stop ssh scripts + the makefile driver.  Knob
+    defaults follow the reference's cloud profile (heartbeat=50ms,
+    remote-run.sh) with this framework's window/cadence settings."""
+    subst = {
+        "__REMOTE_DIR__": remote_dir, "__PYTHON__": python,
+        "__GOSSIP__": str(layout.gossip_port),
+        "__SUBMIT__": str(layout.submit_port),
+        "__COMMIT__": str(layout.commit_port),
+        "__SERVICE__": str(layout.service_port),
+        "__HEARTBEAT__": str(heartbeat_ms),
+        "__TCP_TIMEOUT__": str(tcp_timeout_ms),
+        "__CACHE__": str(cache_size), "__SEQ_WINDOW__": str(seq_window),
+        "__CONSENSUS_INTERVAL__": str(consensus_interval_ms),
+        "__PACKAGE_DIR__": os.path.dirname(os.path.abspath(__file__)),
+    }
+    out = []
+    for name, tpl in (
+        ("start.sh", _START_SH), ("stop.sh", _STOP_SH),
+        ("push.sh", _PUSH_SH), ("makefile", _MAKEFILE),
+    ):
+        path = os.path.join(base_dir, name)
+        body = tpl
+        for token, value in subst.items():
+            body = body.replace(token, value)
+        with open(path, "w") as f:
+            f.write(body)
+        if name.endswith(".sh"):
+            os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+        out.append(path)
+    with open(os.path.join(base_dir, "hosts.txt"), "w") as f:
+        f.write("\n".join(layout.hosts) + "\n")
+    out.append(os.path.join(base_dir, "hosts.txt"))
+    return out
+
+
+def watch_hosts(layout: HostLayout) -> List[Dict[str, str]]:
+    """One /Stats sweep across the hosts (terraform/scripts/watch.sh)."""
+    rows = []
+    for i in range(len(layout.hosts)):
+        addr = layout.of(i)["service"]
+        try:
+            rows.append(fetch_stats(addr))
+        except OSError as e:
+            rows.append({"id": str(i), "error": str(e)})
+    return rows
+
+
+async def bombard_hosts(
+    layout: HostLayout, rate: float, duration: float, seed: int = 0
+) -> int:
+    """Flood transactions round-robin across the hosts' submit ports
+    (terraform/scripts/bombard.sh)."""
+    import asyncio
+    import random
+    import time
+
+    from .proxy.jsonrpc import JsonRpcClient, b64e
+
+    rng = random.Random(seed)
+    clients = [
+        JsonRpcClient(layout.of(i)["submit"], timeout=15.0)
+        for i in range(len(layout.hosts))
+    ]
+    sent = 0
+    attempt = 0
+    t_end = time.monotonic() + duration
+    try:
+        while time.monotonic() < t_end:
+            i = attempt % len(clients)
+            attempt += 1
+            payload = f"bomb-{sent}-{rng.getrandbits(32):08x}".encode()
+            try:
+                await clients[i].call("Babble.SubmitTx", b64e(payload))
+                sent += 1
+            except (OSError, RuntimeError):
+                await asyncio.sleep(0.05)
+                continue
+            await asyncio.sleep(1.0 / rate)
+    finally:
+        for c in clients:
+            await c.close()
+    return sent

@@ -181,7 +181,13 @@ class TCPTransport(Transport):
                     f"response frame of {ln} bytes exceeds "
                     f"{_frame_cap(req.RTYPE)}"
                 )
-            payload = await asyncio.wait_for(reader.readexactly(ln), timeout)
+            # body read budget scales with the frame (a legal 200 MB
+            # snapshot must not be killed by the sync timeout; floor
+            # assumption ~1 MB/s)
+            body_timeout = timeout + ln / (1024 * 1024)
+            payload = await asyncio.wait_for(
+                reader.readexactly(ln), body_timeout
+            )
             if ok != 0:
                 raise TransportError(payload.decode(errors="replace"))
             resp = req.RESPONSE_CLS.unpack(payload)

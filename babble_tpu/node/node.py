@@ -282,6 +282,10 @@ class Node:
                 "auto_compact": bool(cs),
                 "seq_window": self.conf.seq_window or cs or 256,
                 "consensus_window": 2 * cs if cs else None,
+                # None -> the engine derives its own default from e_cap;
+                # the peer's serialized values must not survive
+                "compact_min": None,
+                "round_margin": 2,
             }
             loop = asyncio.get_running_loop()
             async with self.core_lock:

@@ -7,9 +7,11 @@ The reference piggy-backs Go pprof on the same listener (cmd/main.go:26,
 ``import _ "net/http/pprof"``); the equivalents here are the profilers
 this runtime actually has:
 
-- ``/debug/trace?seconds=S&dir=D`` — capture a jax profiler trace
-  (device kernels + host timeline, viewable in xprof/tensorboard) of the
-  next S seconds of live operation.
+- ``/debug/trace?seconds=S`` — capture a jax profiler trace (device
+  kernels + host timeline, viewable in xprof/tensorboard) of the next S
+  seconds of live operation, into a fresh private tempdir (returned in
+  the response; the listener is unauthenticated, so no caller-chosen
+  output paths).
 - ``/debug/profile?seconds=S``     — cProfile of the event-loop thread
   for S seconds, returned as pstats text (executor threads — the device
   dispatch path — need the jax trace above instead).

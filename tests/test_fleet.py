@@ -1,0 +1,56 @@
+"""Multi-host fleet tooling (the reference terraform/makefile analogue)."""
+
+import json
+import os
+import stat
+
+from babble_tpu.fleet import (
+    HostLayout,
+    build_fleet_conf,
+    write_deploy_scripts,
+)
+
+
+def test_fleet_conf_and_scripts(tmp_path):
+    hosts = ["10.0.1.10", "10.0.1.11", "10.0.1.12", "10.0.1.13"]
+    layout = HostLayout(hosts)
+    base = str(tmp_path)
+    dirs = build_fleet_conf(os.path.join(base, "conf"), layout)
+    assert len(dirs) == 4
+    # every datadir has a key and the SAME peer set against real addresses
+    peer_sets = []
+    for d in dirs:
+        assert os.path.exists(os.path.join(d, "priv_key.pem"))
+        peers = json.load(open(os.path.join(d, "peers.json")))
+        peer_sets.append(json.dumps(peers, sort_keys=True))
+        addrs = {p["NetAddr"] for p in peers}
+        assert addrs == {f"{h}:1337" for h in hosts}
+    assert len(set(peer_sets)) == 1
+
+    files = write_deploy_scripts(base, layout)
+    names = {os.path.basename(f) for f in files}
+    assert names == {"start.sh", "stop.sh", "push.sh", "makefile",
+                     "hosts.txt"}
+    start = open(os.path.join(base, "start.sh")).read()
+    # the remote command carries this framework's live-path knobs
+    for flag in ("--seq_window", "--consensus_interval", "--cache_size",
+                 "babble_tpu.cli run"):
+        assert flag in start, flag
+    assert "__" not in start, "unsubstituted template token"
+    assert os.stat(os.path.join(base, "start.sh")).st_mode & stat.S_IEXEC
+    mk = open(os.path.join(base, "makefile")).read()
+    for verb in ("conf:", "push:", "start:", "watch:", "bombard:", "stop:"):
+        assert verb in mk, verb
+    assert open(os.path.join(base, "hosts.txt")).read().split() == hosts
+
+
+def test_fleet_conf_idempotent(tmp_path):
+    """Re-running conf keeps existing keys (same peers.json), like the
+    reference's build-conf being safe to re-run."""
+    hosts = ["192.168.0.1", "192.168.0.2", "192.168.0.3"]
+    layout = HostLayout(hosts)
+    base = os.path.join(str(tmp_path), "conf")
+    build_fleet_conf(base, layout)
+    first = open(os.path.join(base, "node0", "peers.json")).read()
+    build_fleet_conf(base, layout)
+    assert open(os.path.join(base, "node0", "peers.json")).read() == first
