@@ -518,12 +518,11 @@ def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
     state = _write_batch_fields(state, cfg, batch)
 
     def _fd_batch(state, slot_sched):
-        # Measured cost model (v5e): the reverse scan pays ~25 us per
-        # level step; the chain-view compare-count pays ~E^2 / 3e10 s.
-        # Deep narrow DAGs (64x65k: 3,494 levels) favor the count; wide
-        # ones (1024x100k: 392 levels; 256x1M) favor the scan by up to
-        # 12x.  Both are bit-identical (differentially tested).
-        if batch.sched.shape[0] < (cfg.e_cap ** 2) * 4.8e-7:
+        # both strategies are bit-identical (differentially tested);
+        # choice by the measured cost model in state.fd_reverse_scan_wins
+        from .state import fd_reverse_scan_wins
+
+        if fd_reverse_scan_wins(batch.sched.shape[0], cfg.e_cap):
             return _fd_reverse_scan(state, cfg, slot_sched)
         return _fd_full(state, cfg)
 

@@ -134,3 +134,23 @@ def test_fork_budget_rejects_spam():
         fh6.insert_event(ev.clone())
     fh6.run_consensus()
     assert len(fh6.consensus_events()) > 0
+
+
+def test_fd_reverse_matches_chain_counts():
+    """Both fork fd strategies (reverse level scan vs chain-view compare-
+    count) must produce identical tensors."""
+    import jax
+    import numpy as np
+
+    from babble_tpu.ops import forks as F
+
+    dag = random_byzantine_dag(7, 300, seed=9, fork_rate=0.08)
+    fh = ForkHashgraph(dag.participants, k=2)
+    for ev in dag.events:
+        fh.insert_event(ev.clone())
+    cfg, _ = fh._run()
+    batch = fh.dag.build_batch(cfg)
+    la = jax.jit(lambda b: F._la_scan(cfg, b))(batch)
+    a = np.asarray(jax.jit(lambda b: F._fd_reverse(cfg, b))(batch))
+    c = np.asarray(jax.jit(lambda b: F._fd_chains(cfg, b, la))(batch))
+    assert (a == c).all(), f"{int((a != c).sum())} fd mismatches"
