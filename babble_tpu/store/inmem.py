@@ -1,6 +1,14 @@
 """In-memory store: LRU events/rounds + rolling consensus log + per-creator
 event sequences (reference: hashgraph/inmem_store.go, hashgraph/caches.go,
 hashgraph/roundInfo.go).
+
+Role note: this is the *reference-shaped* store, used by the differential
+oracle (consensus/oracle.py) so its storage semantics — LRU windows,
+RollingList eviction, ErrTooLate — match the Go engine it mirrors.  The
+production path stores host state in core/dag.py's HostDag, whose
+OffsetList windows implement the same TooLate contract but are driven by
+consensus progress (engine.maybe_compact) instead of cache size, in
+lockstep with the device tensors' rolling windows (ops/state.py).
 """
 
 from __future__ import annotations
