@@ -11,6 +11,9 @@ psum-style vote reductions) that replace babble's vote-counting loops.
 """
 
 from .mesh import make_mesh
+from .multihost import (
+    bootstrap, broadcast_batch, global_mesh, make_multihost_step,
+)
 from .sharded import (
     batch_shardings,
     consensus_step_impl,
@@ -23,6 +26,7 @@ from .sharded import (
 )
 
 __all__ = [
+    "bootstrap", "broadcast_batch", "global_mesh", "make_multihost_step",
     "make_mesh",
     "state_specs",
     "state_shardings",
