@@ -97,7 +97,8 @@ async def _run_node(args) -> int:
     node = Node(conf, key, peers, transport, proxy, engine=engine)
     if engine is None:
         node.init()
-    service = Service(args.service_addr, node)
+    service = Service(args.service_addr, node,
+                      allow_remote_debug=args.allow_remote_debug)
     await service.start()
     print(f"node {node.core.id} listening on {transport.local_addr()}, "
           f"stats on http://{service.bind_addr}/Stats")
@@ -345,6 +346,9 @@ def main(argv=None) -> int:
     rn.add_argument("--client_addr", default="127.0.0.1:1339",
                     help="the app's CommitTx server")
     rn.add_argument("--service_addr", default="127.0.0.1:8000")
+    rn.add_argument("--allow_remote_debug", action="store_true",
+                    help="serve /debug/* to non-loopback callers "
+                         "(default: loopback only)")
     rn.add_argument("--log_level", default="info")
     rn.add_argument("--heartbeat", type=int, default=1000, help="ms")
     rn.add_argument("--max_pool", type=int, default=2)

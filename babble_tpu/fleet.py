@@ -28,7 +28,7 @@ from typing import Dict, List, Optional
 
 from .crypto.keys import PemKeyFile, generate_key
 from .net.peers import JSONPeers, Peer
-from .testnet import fetch_stats
+from .testnet import HTTPException, fetch_stats
 
 GOSSIP_PORT = 1337   # the reference's conventional ports
 SUBMIT_PORT = 1338   # (terraform/scripts/remote-run.sh:12-19)
@@ -193,7 +193,10 @@ def watch_hosts(layout: HostLayout) -> List[Dict[str, str]]:
         addr = layout.of(i)["service"]
         try:
             rows.append(fetch_stats(addr))
-        except OSError as e:
+        except (OSError, ValueError, HTTPException) as e:
+            # ValueError covers json.JSONDecodeError from a malformed /Stats
+            # body, HTTPException a garbage status line — one bad host must
+            # not crash the whole watch sweep
             rows.append({"id": str(i), "error": str(e)})
     return rows
 

@@ -63,14 +63,27 @@ class Core:
         Validates before swapping so a bad snapshot can't leave the Core
         half-migrated.  The eviction policy keeps every creator's last
         seq_window events, so a non-empty chain always has a live tail;
-        an empty window despite a non-zero count means a corrupt snapshot."""
-        chain = engine.dag.chains[self.participants[self.pub_hex]]
+        an empty window despite a non-zero count means a corrupt snapshot.
+
+        If our local chain is *ahead* of the snapshot's view of us (our
+        newer events already reached other peers before the partition), we
+        must not roll head/seq back — the next self-event would reuse an
+        index and read as an equivocation, permanently poisoning our gossip
+        (ADVICE r2 medium).  The local tail beyond the snapshot is replayed
+        into the new engine; if any of it is not insertable there (an
+        other-parent outside the snapshot window), bootstrap refuses and
+        the old engine stays in place."""
+        cid = self.participants[self.pub_hex]
+        chain = engine.dag.chains[cid]
         if chain and not chain.window:
             raise ValueError(
                 "snapshot window holds none of our own chain tail"
             )
+        snap_seq = engine.dag.events[chain[-1]].index if chain else -1
+        if self.seq > snap_seq:
+            self._replay_own_tail(engine, cid, snap_seq)
         if chain:
-            head_ev = engine.dag.events[chain[-1]]
+            head_ev = engine.dag.events[engine.dag.chains[cid][-1]]
             self.hg = engine
             self.head = head_ev.hex()
             self.seq = head_ev.index
@@ -81,6 +94,37 @@ class Core:
             self.head = ""
             self.seq = -1
             self.init()
+
+    def _replay_own_tail(
+        self, engine: TpuHashgraph, cid: int, snap_seq: int
+    ) -> None:
+        """Re-insert our own events with index in (snap_seq, self.seq] from
+        the current engine into ``engine``.  Raises ValueError (refusing the
+        bootstrap) if the tail is locally evicted or not insertable there.
+        ``topological_index`` is restored on failure: insert() stamps it
+        with the new engine's slots, and the old engine's gossip diff sort
+        must stay intact when we keep it."""
+        old_chain = self.hg.dag.chains[cid]
+        tail = []
+        for q in range(snap_seq + 1, self.seq + 1):
+            if q < old_chain.start:
+                raise ValueError(
+                    f"own-chain tail seq {q} locally evicted; cannot "
+                    "reconcile snapshot behind our published chain"
+                )
+            tail.append(self.hg.dag.events[old_chain[q]])
+        saved = [(ev, ev.topological_index) for ev in tail]
+        try:
+            for ev in tail:
+                engine.insert_event(ev)
+        except Exception as e:
+            for ev, ti in saved:
+                ev.topological_index = ti
+            raise ValueError(
+                f"snapshot is behind our published chain (local seq "
+                f"{self.seq} > snapshot {snap_seq}) and the tail is not "
+                f"insertable into it: {e}"
+            ) from e
 
     def init(self) -> None:
         """Create + insert the node's root event (reference core.go:79-97)."""

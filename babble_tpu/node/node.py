@@ -254,6 +254,39 @@ class Node:
             self.sync_errors += 1
             self.logger.warning("gossip to %s failed: %s", peer_addr, e)
 
+    def ff_max_caps(self) -> tuple:
+        """(max_e, max_s, max_r) capacity bounds a fast-forward snapshot
+        may declare — generous multiples of our own memory policy, so a
+        hostile peer cannot OOM us with absurd array shapes."""
+        n = len(self.core.participants)
+        max_e = max(1 << 22, 64 * (self.conf.cache_size or 256) * n)
+        return (max_e, 1 << 20, 1 << 16)
+
+    def validate_ff_snapshot(self, engine) -> None:
+        """Trust boundary for catch-up (ADVICE r2 high): snapshot trust
+        extends to *ordering metadata only*, never membership.  A snapshot
+        whose participant set differs from our canonical local peers.json
+        could swap in a fabricated validator set whose self-consistent
+        signatures pass every later check — reject it outright.
+
+        load_snapshot already enforces this on the declared meta before
+        materializing anything (the cheap-to-reject path); this re-check on
+        the restored engine is the belt-and-braces invariant the rest of
+        the runtime relies on."""
+        if engine.participants != self.core.participants:
+            raise ValueError(
+                "fast-forward snapshot participant set does not match "
+                "local peers ({} vs {} entries)".format(
+                    len(engine.participants), len(self.core.participants)
+                )
+            )
+        cap = engine.cfg
+        max_e, max_s, max_r = self.ff_max_caps()
+        if cap.e_cap > max_e or cap.s_cap > max_s or cap.r_cap > max_r:
+            raise ValueError(
+                f"fast-forward snapshot capacities out of bounds: {cap}"
+            )
+
     async def _fast_forward(self, peer_addr: str) -> None:
         """Catch-up: fetch a snapshot and restart consensus from it.
 
@@ -289,10 +322,20 @@ class Node:
             }
             loop = asyncio.get_running_loop()
             async with self.core_lock:
+                # membership + capacity bounds are enforced INSIDE
+                # load_snapshot on the declared meta and the npy headers,
+                # before any array decompresses or any signature verifies —
+                # a hostile snapshot must cost nothing to reject
                 engine = await loop.run_in_executor(
                     None,
-                    lambda: load_snapshot(resp.snapshot, policy=policy),
+                    lambda: load_snapshot(
+                        resp.snapshot,
+                        policy=policy,
+                        expected_participants=self.core.participants,
+                        max_caps=self.ff_max_caps(),
+                    ),
                 )
+                self.validate_ff_snapshot(engine)
                 self.core.bootstrap(engine)
             self.logger.warning(
                 "fast-forwarded from %s: %d events in window, lcr=%s",

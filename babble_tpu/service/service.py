@@ -28,11 +28,20 @@ from urllib.parse import parse_qs, urlsplit
 from ..common.aserver import AsyncTcpServer
 
 
+#: trace tempdirs kept per service; older ones are deleted so repeated
+#: /debug/trace calls cannot accumulate unbounded disk use
+_MAX_TRACE_DIRS = 4
+
+
 class Service:
-    def __init__(self, bind_addr: str, node):
+    def __init__(self, bind_addr: str, node, allow_remote_debug: bool = False):
         self.node = node
         self._server = AsyncTcpServer(bind_addr, self._handle)
         self._profiling = False
+        # /debug can start profilers and dump internals; the stats listener
+        # is unauthenticated, so by default only loopback callers get it
+        self.allow_remote_debug = allow_remote_debug
+        self._trace_dirs: list = []
 
     @property
     def bind_addr(self) -> str:
@@ -91,6 +100,11 @@ class Service:
             # unauthenticated, so a caller-chosen path would be an
             # arbitrary-filesystem-write primitive
             out_dir = tempfile.mkdtemp(prefix="babble-trace-")
+            self._trace_dirs.append(out_dir)
+            while len(self._trace_dirs) > _MAX_TRACE_DIRS:
+                import shutil
+
+                shutil.rmtree(self._trace_dirs.pop(0), ignore_errors=True)
             self._profiling = True
             started = False
             try:
@@ -124,9 +138,16 @@ class Service:
             body = json.dumps(self.node.get_stats()).encode()
             status = "200 OK"
         elif path.startswith("/debug/"):
-            body, status, ctype = await self._debug(
-                path, parse_qs(split.query)
-            )
+            peer = writer.get_extra_info("peername")
+            peer_ip = peer[0] if peer else ""
+            local = peer_ip in ("127.0.0.1", "::1", "::ffff:127.0.0.1")
+            if local or self.allow_remote_debug:
+                body, status, ctype = await self._debug(
+                    path, parse_qs(split.query)
+                )
+            else:
+                body = b'{"error": "debug endpoints are loopback-only"}'
+                status = "403 Forbidden"
         else:
             body = b'{"error": "not found"}'
             status = "404 Not Found"
@@ -140,3 +161,7 @@ class Service:
 
     async def close(self) -> None:
         await self._server.close()
+        import shutil
+
+        while self._trace_dirs:
+            shutil.rmtree(self._trace_dirs.pop(), ignore_errors=True)

@@ -137,11 +137,49 @@ def snapshot_bytes(engine: TpuHashgraph) -> bytes:
     )
 
 
+def _expected_layout(cfg: DagConfig) -> Dict[str, tuple]:
+    """(shape, dtype) of every DagState field for capacity cfg — mirrors
+    init_state without allocating anything."""
+    e1, n, s1, r1 = cfg.e_cap + 1, cfg.n, cfg.s_cap + 1, cfg.r_cap + 1
+    i32, i64 = np.dtype(np.int32), np.dtype(np.int64)
+    b, i8 = np.dtype(np.bool_), np.dtype(np.int8)
+    ev, sc = (e1,), ()
+    return {
+        "sp": (ev, i32), "op": (ev, i32), "creator": (ev, i32),
+        "seq": (ev, i32), "ts": (ev, i64), "mbit": (ev, b),
+        "la": ((e1, n), i32), "fd": ((e1, n), i32),
+        "round": (ev, i32), "witness": (ev, b), "rr": (ev, i32),
+        "cts": (ev, i64),
+        "ce": ((n + 1, s1), i32), "cnt": ((n + 1,), i32),
+        "wslot": ((r1, n), i32), "famous": ((r1, n), i8),
+        "n_events": (sc, i32), "max_round": (sc, i32), "lcr": (sc, i32),
+        "e_off": (sc, i32), "s_off": ((n + 1,), i32), "r_off": (sc, i32),
+    }
+
+
+def _peek_npz_layout(z) -> Dict[str, tuple]:
+    """Read each member's (shape, dtype) from its npy header WITHOUT
+    decompressing the payload — a zlib-bombed snapshot must be rejected
+    before its arrays are materialized."""
+    out = {}
+    for name in z.files:
+        with z.zip.open(name + ".npy") as f:
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                shape, _, dtype = np.lib.format.read_array_header_1_0(f)
+            else:
+                shape, _, dtype = np.lib.format.read_array_header_2_0(f)
+        out[name] = (shape, dtype)
+    return out
+
+
 def load_snapshot(
     data: bytes,
     commit_callback: Optional[Callable] = None,
     verify_events: bool = True,
     policy: Optional[dict] = None,
+    expected_participants: Optional[Dict[str, int]] = None,
+    max_caps: Optional[tuple] = None,
 ) -> TpuHashgraph:
     """Reconstruct an engine from snapshot bytes (the fast-forward
     bootstrap).  The snapshot comes from a *peer*, so every event
@@ -152,12 +190,41 @@ def load_snapshot(
     our signature checks off or replace our memory bounds.  The consensus
     fields (rounds, fame, order) are taken on trust from the serving peer
     — the same trust-on-catch-up assumption babbleio's fast-sync makes,
-    pending signed state proofs."""
+    pending signed state proofs.
+
+    ``expected_participants`` / ``max_caps`` (``(max_e, max_s, max_r)``)
+    are enforced on the *declared meta* before any array is materialized
+    and re-checked against the actual npy headers before decompression,
+    so a hostile peer can neither swap the validator set nor OOM us with
+    absurd (or lied-about) array shapes."""
     import io
 
     meta_b, npz_b = msgpack.unpackb(data, raw=False)
     meta = msgpack.unpackb(meta_b, raw=False, strict_map_key=False)
+    participants = {k: int(v) for k, v in meta["participants"]}
+    cfg = DagConfig(*meta["cfg"])
+    if expected_participants is not None and participants != expected_participants:
+        raise ValueError(
+            "snapshot participant set does not match local peers "
+            f"({len(participants)} vs {len(expected_participants)} entries)"
+        )
+    if max_caps is not None:
+        max_e, max_s, max_r = max_caps
+        if cfg.e_cap > max_e or cfg.s_cap > max_s or cfg.r_cap > max_r:
+            raise ValueError(f"snapshot capacities out of bounds: {cfg}")
     with np.load(io.BytesIO(npz_b)) as z:
+        layout = _peek_npz_layout(z)
+        expected = _expected_layout(cfg)
+        for name in DagState._fields:
+            if name not in layout:
+                raise ValueError(f"snapshot missing array {name}")
+            shape, dtype = layout[name]
+            eshape, edtype = expected[name]
+            if shape != eshape or dtype != edtype:
+                raise ValueError(
+                    f"snapshot array {name} is {dtype}{shape}, declared "
+                    f"cfg implies {edtype}{eshape}"
+                )
         arrays = {name: z[name] for name in DagState._fields}
     engine = _restore_engine(meta, arrays, commit_callback, policy)
     if verify_events:

@@ -26,6 +26,7 @@ import subprocess
 import sys
 import time
 import urllib.request
+from http.client import HTTPException
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -168,7 +169,9 @@ def watch_once(n: int, ports: Optional[PortLayout] = None) -> List[Dict[str, str
         addr = ports.of(i)["service"]
         try:
             out.append(fetch_stats(addr))
-        except OSError as e:
+        except (OSError, ValueError, HTTPException) as e:
+            # ValueError covers a malformed JSON body, HTTPException a
+            # garbage status line — one bad host must not crash the sweep
             out.append({"id": str(i), "error": str(e)})
     return out
 

@@ -130,3 +130,63 @@ def test_core_resumes_head_from_checkpoint(tmp_path):
     # and it can mint the next event without fork rejection
     resumed.add_self_event([b"after-restart"])
     assert resumed.seq == cores[1].seq + 1
+
+
+def test_load_snapshot_rejects_hostile_meta_before_materializing():
+    """Network-path snapshot hardening (ADVICE r2 high): membership and
+    capacity bounds are enforced on the declared meta and the npy headers
+    BEFORE any array decompresses — and meta that lies about its array
+    shapes is caught by the header check."""
+    import io
+
+    import msgpack
+
+    from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+    dag, eng = _build(n=4, n_events=40)
+    for ev in dag.events:
+        eng.insert_event(ev)
+    eng.run_consensus()
+    snap = snapshot_bytes(eng)
+
+    # baseline: valid snapshot loads under matching expectations
+    restored = load_snapshot(
+        snap, verify_events=False,
+        expected_participants=eng.participants,
+        max_caps=(1 << 22, 1 << 20, 1 << 16),
+    )
+    assert restored.known() == eng.known()
+
+    # foreign membership rejected
+    other = dict(eng.participants)
+    first = next(iter(other))
+    other[first + "ff"] = other.pop(first)
+    with pytest.raises(ValueError, match="participant set"):
+        load_snapshot(snap, verify_events=False,
+                      expected_participants=other)
+
+    # declared capacities beyond bounds rejected (meta-only check: the
+    # arrays never even get their headers read)
+    meta_b, npz_b = msgpack.unpackb(snap, raw=False)
+    meta = msgpack.unpackb(meta_b, raw=False, strict_map_key=False)
+    lied = dict(meta)
+    lied["cfg"] = list(meta["cfg"])
+    lied["cfg"][1] = 1 << 30  # e_cap
+    hostile = msgpack.packb(
+        [msgpack.packb(lied, use_bin_type=True), npz_b], use_bin_type=True
+    )
+    with pytest.raises(ValueError, match="capacities out of bounds"):
+        load_snapshot(hostile, verify_events=False,
+                      max_caps=(1 << 22, 1 << 20, 1 << 16))
+
+    # meta that lies SMALL about its shapes (ships bigger arrays than cfg
+    # declares) is caught by the pre-decompression header check
+    lied2 = dict(meta)
+    lied2["cfg"] = list(meta["cfg"])
+    lied2["cfg"][1] = max(4, meta["cfg"][1] // 2)
+    hostile2 = msgpack.packb(
+        [msgpack.packb(lied2, use_bin_type=True), npz_b], use_bin_type=True
+    )
+    with pytest.raises(ValueError, match="declared"):
+        load_snapshot(hostile2, verify_events=False,
+                      max_caps=(1 << 22, 1 << 20, 1 << 16))

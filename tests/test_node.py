@@ -326,3 +326,75 @@ def test_fast_forward_rejoins_evicted_window():
             await nd.shutdown()
 
     asyncio.run(go())
+
+
+def test_ff_snapshot_validation_rejects_foreign_membership_and_absurd_caps():
+    """Catch-up trust covers ordering metadata only, never membership: a
+    snapshot serving a different validator set (or absurd array capacities)
+    must be rejected before Core.bootstrap (ADVICE r2 high)."""
+    from babble_tpu.consensus.engine import TpuHashgraph
+
+    async def go():
+        net = InmemNetwork()
+        key = generate_key()
+        t = net.transport()
+        peers = [Peer(net_addr=t.local_addr(), pub_key_hex=key.pub_hex)]
+        node = Node(Config.test_config(), key, peers, t, InmemAppProxy())
+        node.init()
+
+        foreign = TpuHashgraph({generate_key().pub_hex: 0}, e_cap=64)
+        with pytest.raises(ValueError, match="participant set"):
+            node.validate_ff_snapshot(foreign)
+
+        big = TpuHashgraph({key.pub_hex: 0}, e_cap=64)
+        big.cfg = big.cfg._replace(e_cap=1 << 30)
+        with pytest.raises(ValueError, match="capacities"):
+            node.validate_ff_snapshot(big)
+
+        ok = TpuHashgraph({key.pub_hex: 0}, e_cap=64)
+        node.validate_ff_snapshot(ok)   # same membership, sane caps: passes
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+def test_bootstrap_replays_local_tail_or_refuses():
+    """A fast-forward snapshot that is *behind* our own published chain must
+    not roll head/seq back (index reuse would read as equivocation).  The
+    local tail is replayed into the new engine when insertable; otherwise
+    bootstrap refuses and the old engine stays (ADVICE r2 medium)."""
+    cores = _make_cores(3)
+    c0, c1, c2 = cores
+
+    # c1 learns c0's root, then c0 advances two self-events past that view
+    _synchronize(c0, c1, [])
+    c0.add_self_event([b"t1"])
+    c0.add_self_event([b"t2"])
+    assert c0.seq == 2
+    head_before = c0.head
+
+    snap = c1.hg   # knows c0 only up to seq 0
+    c0.bootstrap(snap)
+    assert c0.hg is snap
+    assert c0.seq == 2 and c0.head == head_before, "tail must be replayed"
+    # the replayed tail is actually in the adopted engine
+    cid = c0.participants[c0.pub_hex]
+    assert len(snap.dag.chains[cid]) == 3
+
+    # refusal: c2's head is unknown to a fresh snapshot engine, so a tail
+    # whose other-parent rides on c2 cannot be replayed there
+    cores2 = _make_cores(3)
+    d0, d1, d2 = cores2
+    _synchronize(d0, d1, [])          # d1 knows d0's root only
+    _synchronize(d2, d0, [])          # d0's new head has d2's root as parent
+    old_engine = d0.hg
+    old_head = d0.head
+    old_ti = [
+        (ev, ev.topological_index)
+        for ev in old_engine.dag.events.window
+    ]
+    with pytest.raises(ValueError, match="not insertable"):
+        d0.bootstrap(d1.hg)
+    assert d0.hg is old_engine and d0.head == old_head
+    for ev, ti in old_ti:
+        assert ev.topological_index == ti, "gossip sort keys must survive"
