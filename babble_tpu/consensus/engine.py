@@ -278,7 +278,9 @@ class TpuHashgraph:
 
     def decide_fame(self) -> None:
         self.flush()
-        self.state = fame_ops.decide_fame(self.cfg, self.state)
+        # batch_window=False: the live engine rolls windows, so wide-N
+        # fame must use the absolute-seq compare path (fame.py docstring)
+        self.state = fame_ops.decide_fame_auto(self.cfg, self.state, False)
         self._view = {}
 
     def find_order(self) -> List[Event]:

@@ -37,6 +37,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .ss import ss_counts
 from .state import (
     INT32_MAX, DagConfig, DagState, I32, I64, sanitize, set_sentinel,
 )
@@ -56,19 +57,25 @@ class EventBatch(NamedTuple):
     sched: jnp.ndarray    # i32[T, B] batch positions grouped by level, -1 pad
 
 
-def _reset_event_sentinels(state: DagState, cfg: DagConfig) -> DagState:
-    """Padding lanes dump writes into the last row/col of each array; restore
-    the sentinel values afterwards so gathers of missing refs stay neutral.
+def _reset_coord_sentinels(state: DagState, cfg: DagConfig) -> DagState:
+    """Restore the sentinel row/col of everything the *coords* phase
+    writes (batch fields, la/fd, chain tables) — padding lanes dump
+    writes there; gathers of missing refs must stay neutral.
 
     Uses ``set_sentinel`` (elementwise selects over iota masks) — see its
     docstring for why ``.at[sentinel].set()`` corrupts sharded arrays
     (observed: ce/cnt rows wiped at the clamped index on an ("ev","p")
-    mesh)."""
-    e, n, s, r = cfg.e_cap, cfg.n, cfg.s_cap, cfg.r_cap
+    mesh).
+
+    Split from the rounds-phase reset so la/fd are strictly read-only in
+    the rounds program: at 10k participants they are 3.7 GB each, and any
+    write (even an elementwise sentinel restore) after the round-march
+    while-loop makes XLA keep remat copies of both across the loop —
+    +7.5 GB of temps, an OOM on one v5e chip."""
+    e, n, s = cfg.e_cap, cfg.n, cfg.s_cap
     e_row = jnp.arange(e + 1) == e        # [E+1]
     n_row = jnp.arange(n + 1) == n        # [N+1]
     s_col = jnp.arange(s + 1) == s        # [S+1]
-    r_row = jnp.arange(r + 1) == r        # [R+1]
     setv = set_sentinel
 
     return state._replace(
@@ -80,14 +87,31 @@ def _reset_event_sentinels(state: DagState, cfg: DagConfig) -> DagState:
         mbit=setv(state.mbit, e_row, False),
         la=setv(state.la, e_row[:, None], -1),
         fd=setv(state.fd, e_row[:, None], INT32_MAX),
+        ce=setv(state.ce, n_row[:, None] | s_col[None, :], -1),
+        cnt=setv(state.cnt, n_row, 0),
+    )
+
+
+def _reset_round_sentinels(state: DagState, cfg: DagConfig) -> DagState:
+    """Restore the sentinel rows the *rounds* phase writes (round /
+    witness / order fields + witness table)."""
+    e, n, r = cfg.e_cap, cfg.n, cfg.r_cap
+    e_row = jnp.arange(e + 1) == e        # [E+1]
+    r_row = jnp.arange(r + 1) == r        # [R+1]
+    setv = set_sentinel
+
+    return state._replace(
         round=setv(state.round, e_row, -1),
         witness=setv(state.witness, e_row, False),
         rr=setv(state.rr, e_row, -1),
         cts=setv(state.cts, e_row, 0),
-        ce=setv(state.ce, n_row[:, None] | s_col[None, :], -1),
-        cnt=setv(state.cnt, n_row, 0),
         wslot=setv(state.wslot, r_row[:, None], -1),
     )
+
+
+def _reset_event_sentinels(state: DagState, cfg: DagConfig) -> DagState:
+    """Full sentinel restore (both phases' arrays)."""
+    return _reset_round_sentinels(_reset_coord_sentinels(state, cfg), cfg)
 
 
 def _write_batch_fields(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
@@ -389,30 +413,24 @@ def _la_absorb(state: DagState, cfg: DagConfig) -> DagState:
     return state._replace(la=la)
 
 
-def _rounds_frontier(state: DagState, cfg: DagConfig) -> DagState:
-    """Round assignment as a per-round witness-frontier march —
-    O(actual rounds) sequential steps instead of O(levels).
+def frontier_init(state: DagState, cfg: DagConfig):
+    """Initial carry of the witness-frontier march."""
+    n, r_cap = cfg.n, cfg.r_cap
+    cnt = state.cnt[:n] - state.s_off[:n]
+    pos0 = jnp.where(cnt > 0, 0, INT32_MAX)
+    pos_table0 = jnp.full((r_cap + 1, n), INT32_MAX, I32).at[0].set(pos0)
+    return pos0, pos_table0
 
-    pos[r, j] := seq of the first chain-j event with round >= r.  Step r
-    advances the frontier: an event has round >= r+1 iff it strongly sees
-    a supermajority of round-r witnesses (round(x) = parentRound + inc,
-    hashgraph.go:263-305) or descends from such an event.  Within a chain
-    both the strongly-see count and descent are monotone in seq, so the
-    first self-inc position is a bisection over the chain and descent
-    inheritance is fd of the per-chain first inc events.
 
-    Candidate witnesses whose true round exceeds r ("jumps" via the other
-    parent) are harmless in the supermajority count: any event that
-    strongly sees a jumped candidate also descends from the candidate's
-    round>r ancestor and is therefore in the >=r+1 region regardless.
-    Exact witness tables are derived from pos afterwards, so fame voting
-    only ever sees true round-r witnesses.
+def frontier_step_math(
+    state: DagState, cfg: DagConfig, r: jnp.ndarray,
+    pos: jnp.ndarray, pos_table: jnp.ndarray,
+):
+    """One frontier-march round step (shared between the fused while-loop
+    form and the host-driven wide pipeline): advance pos[j] — the seq of
+    the first chain-j event with round >= r — to round r+1.
 
-    Window note: the march starts from each chain's window base and round
-    r_off, so it is only exact when the window base IS the round-r_off
-    witness frontier — true for fresh states (all offsets zero), which is
-    the only way the engine reaches this path ('fast'/'absorb' batch
-    modes).  The live rolled-window path uses the incremental level scan."""
+    Returns (pos_next, pos_table, any_next)."""
     n, sm, s_cap, r_cap = cfg.n, cfg.super_majority, cfg.s_cap, cfg.r_cap
     s_off = state.s_off[:n]
     cnt = state.cnt[:n] - s_off                            # windowed lengths
@@ -420,55 +438,55 @@ def _rounds_frontier(state: DagState, cfg: DagConfig) -> DagState:
     rows = jnp.arange(n)
     bisect_iters = max(1, (s_cap + 1).bit_length())
 
-    pos0 = jnp.where(cnt > 0, 0, INT32_MAX)
-    pos_table0 = jnp.full((r_cap + 1, n), INT32_MAX, I32).at[0].set(pos0)
+    valid_w = pos < cnt
+    ws = cej[rows, jnp.clip(pos, 0, s_cap)]
+    fdw = state.fd[sanitize(jnp.where(valid_w, ws, -1), cfg.e_cap)]
 
-    def step(carry):
-        r, pos, pos_table, _ = carry
-        valid_w = pos < cnt
-        ws = cej[rows, jnp.clip(pos, 0, s_cap)]
-        fdw = state.fd[sanitize(jnp.where(valid_w, ws, -1), cfg.e_cap)]
+    # bisection for the first self-inc position per chain
+    lo = jnp.where(valid_w, pos, cnt)
+    hi = cnt
+    for _ in range(bisect_iters):
+        mid = (lo + hi) >> 1
+        xs = cej[rows, jnp.clip(mid, 0, s_cap)]
+        lax_rows = state.la[sanitize(xs, cfg.e_cap)]   # [N, N]
+        # blocked strongly-see (ops.ss): this path only runs on fresh
+        # states (window offsets zero — see the docstring), which is
+        # exactly the one-hot MXU path's validity condition
+        ss_cnt = ss_counts(lax_rows, fdw, s_cap, batch_window=True)
+        ss = (ss_cnt >= sm) & valid_w[None, :]
+        ok = ss.sum(-1) >= sm
+        active = lo < hi
+        hi = jnp.where(ok & active, mid, hi)
+        lo = jnp.where(~ok & active, mid + 1, lo)
+    s_star = lo
+    found = s_star < cnt
 
-        # bisection for the first self-inc position per chain
-        lo = jnp.where(valid_w, pos, cnt)
-        hi = cnt
-        for _ in range(bisect_iters):
-            mid = (lo + hi) >> 1
-            xs = cej[rows, jnp.clip(mid, 0, s_cap)]
-            lax_rows = state.la[sanitize(xs, cfg.e_cap)]   # [N, N]
-            ss_cnt = (lax_rows[:, None, :] >= fdw[None, :, :]).sum(-1)
-            ss = (ss_cnt >= sm) & valid_w[None, :]
-            ok = ss.sum(-1) >= sm
-            active = lo < hi
-            hi = jnp.where(ok & active, mid, hi)
-            lo = jnp.where(~ok & active, mid + 1, lo)
-        s_star = lo
-        found = s_star < cnt
-
-        # descent inheritance: fd rows of the per-chain first inc events
-        # (fd values are absolute seqs -> window-local positions)
-        e_star = cej[rows, jnp.clip(s_star, 0, s_cap)]
-        fde = state.fd[sanitize(jnp.where(found, e_star, -1), cfg.e_cap)]
-        inherit = fde.min(axis=0)                          # [N] absolute
-        inherit = jnp.where(
-            inherit == INT32_MAX, INT32_MAX, inherit - s_off
-        )
-        pos_next = jnp.minimum(
-            jnp.where(found, s_star, INT32_MAX), inherit
-        )
-        pos_next = jnp.maximum(pos_next, pos)  # monotone safety
-        any_next = (pos_next < cnt).any()
-        pos_table = pos_table.at[jnp.minimum(r + 1, r_cap)].set(pos_next)
-        return r + 1, pos_next, pos_table, any_next
-
-    def cond(carry):
-        r, _, _, alive = carry
-        return alive & (r < r_cap - 1)
-
-    r_fin, _, pos_table, _ = jax.lax.while_loop(
-        cond, step, (jnp.asarray(0, I32), pos0, pos_table0,
-                     jnp.asarray(True))
+    # descent inheritance: fd rows of the per-chain first inc events
+    # (fd values are absolute seqs -> window-local positions)
+    e_star = cej[rows, jnp.clip(s_star, 0, s_cap)]
+    fde = state.fd[sanitize(jnp.where(found, e_star, -1), cfg.e_cap)]
+    inherit = fde.min(axis=0)                          # [N] absolute
+    inherit = jnp.where(
+        inherit == INT32_MAX, INT32_MAX, inherit - s_off
     )
+    pos_next = jnp.minimum(
+        jnp.where(found, s_star, INT32_MAX), inherit
+    )
+    pos_next = jnp.maximum(pos_next, pos)  # monotone safety
+    any_next = (pos_next < cnt).any()
+    pos_table = pos_table.at[jnp.minimum(r + 1, r_cap)].set(pos_next)
+    return pos_next, pos_table, any_next
+
+
+def frontier_finalize(
+    state: DagState, cfg: DagConfig, pos_table: jnp.ndarray
+) -> DagState:
+    """Derive per-event rounds, witness flags and the witness table from
+    the finished frontier position table."""
+    n, s_cap, r_cap = cfg.n, cfg.s_cap, cfg.r_cap
+    cnt = state.cnt[:n] - state.s_off[:n]
+    cej = state.ce[:n]
+    rows = jnp.arange(n)
 
     # per-event rounds from the pos table: round(x) = |{r : pos[r, c] <= seq}| - 1
     e1 = cfg.e_cap + 1
@@ -498,23 +516,63 @@ def _rounds_frontier(state: DagState, cfg: DagConfig) -> DagState:
     )
 
 
-def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch) -> DagState:
-    """Ingest a topologically-ordered batch of events end to end.
+def _rounds_frontier(state: DagState, cfg: DagConfig) -> DagState:
+    """Round assignment as a per-round witness-frontier march —
+    O(actual rounds) sequential steps instead of O(levels).
 
-    fd_mode:
-    - 'incremental' — O(K·E) fd min-scatter + level-scan rounds (live
-      gossip path; small batches, shallow schedules).
-    - 'full'        — chain-view fd searchsorted + level-scan rounds.
-    - 'fast'        — chain-view fd + per-round frontier rounds (the
-      batch/simulation path; identical outputs, differentially tested).
-    - 'walk'        — like 'fast' but la is filled by the Pallas
-      sequential-walk kernel (pallas_ingest.la_walk) instead of the level
-      scan: one in-VMEM pass over the slot order, ~1.8x faster than the
-      ~3,500-launch scan at 64x65k.  Gated by walk_supported().
-    - 'absorb'      — like 'fast' but with log-depth la self-absorption
-      instead of the level scan; gather-bound on current XLA — superseded
-      by 'walk'.
-    """
+    pos[r, j] := seq of the first chain-j event with round >= r.  Step r
+    advances the frontier: an event has round >= r+1 iff it strongly sees
+    a supermajority of round-r witnesses (round(x) = parentRound + inc,
+    hashgraph.go:263-305) or descends from such an event.  Within a chain
+    both the strongly-see count and descent are monotone in seq, so the
+    first self-inc position is a bisection over the chain and descent
+    inheritance is fd of the per-chain first inc events.
+
+    Candidate witnesses whose true round exceeds r ("jumps" via the other
+    parent) are harmless in the supermajority count: any event that
+    strongly sees a jumped candidate also descends from the candidate's
+    round>r ancestor and is therefore in the >=r+1 region regardless.
+    Exact witness tables are derived from pos afterwards, so fame voting
+    only ever sees true round-r witnesses.
+
+    Window note: the march starts from each chain's window base and round
+    r_off, so it is only exact when the window base IS the round-r_off
+    witness frontier — true for fresh states (all offsets zero), which is
+    the only way the engine reaches this path ('fast'/'absorb' batch
+    modes).  The live rolled-window path uses the incremental level scan.
+
+    NB for wide participant axes: data-dependent gathers from the [E, N]
+    la/fd tensors inside ANY device loop (while/scan/fori) make XLA keep
+    layout-transposed copies of the whole operand — +7.5 GB at 10k
+    participants (measured; see ops/wide.py).  This fused while-loop form
+    is therefore for moderate N; the wide pipeline drives the same
+    frontier_step_math from a host loop."""
+    r_cap = cfg.r_cap
+    pos0, pos_table0 = frontier_init(state, cfg)
+
+    def step(carry):
+        r, pos, pos_table, _ = carry
+        pos_next, pos_table, any_next = frontier_step_math(
+            state, cfg, r, pos, pos_table
+        )
+        return r + 1, pos_next, pos_table, any_next
+
+    def cond(carry):
+        r, _, _, alive = carry
+        return alive & (r < r_cap - 1)
+
+    _, _, pos_table, _ = jax.lax.while_loop(
+        cond, step, (jnp.asarray(0, I32), pos0, pos_table0,
+                     jnp.asarray(True))
+    )
+    return frontier_finalize(state, cfg, pos_table)
+
+
+def ingest_coords_impl(
+    cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
+) -> DagState:
+    """Phase 1 of ingest: write batch fields and fill the la/fd
+    coordinate tensors (everything before round assignment)."""
     state = _write_batch_fields(state, cfg, batch)
 
     def _fd_batch(state, slot_sched):
@@ -540,32 +598,63 @@ def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
         )
         state = _fd_init_own(state, cfg, batch)
         slot_sched = _slot_sched(state.n_events - batch.k, cfg, batch.sched)
-        state = _fd_batch(state, slot_sched)
-        state = _rounds_frontier(state, cfg)
-        return _reset_event_sentinels(state, cfg)
+        return _reset_coord_sentinels(_fd_batch(state, slot_sched), cfg)
     if fd_mode == "absorb":
         state = _la_init_direct(state, cfg, batch)
         state = _la_absorb(state, cfg)
         state = _fd_init_own(state, cfg, batch)
-        state = _fd_full(state, cfg)
-        state = _rounds_frontier(state, cfg)
-        return _reset_event_sentinels(state, cfg)
+        return _reset_coord_sentinels(_fd_full(state, cfg), cfg)
     slot_sched = _slot_sched(state.n_events - batch.k, cfg, batch.sched)
     state = _la_level_scan(state, cfg, slot_sched)
     state = _fd_init_own(state, cfg, batch)
     if fd_mode == "incremental":
         state = _fd_incremental(state, cfg, batch)
-        state = _rounds_level_scan(state, cfg, slot_sched, batch.sched)
-        return _reset_event_sentinels(state, cfg)
-    if fd_mode == "fast":
+    elif fd_mode == "fast":
         # batch path: the schedule covers the whole DAG, so the cheaper
         # of reverse scan / compare-count applies (see _fd_batch)
         state = _fd_batch(state, slot_sched)
-        state = _rounds_frontier(state, cfg)
     else:
         state = _fd_full(state, cfg)
+    return _reset_coord_sentinels(state, cfg)
+
+
+def ingest_rounds_impl(
+    cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch
+) -> DagState:
+    """Phase 2 of ingest: round/witness assignment + sentinel reset.
+    Composes with ingest_coords_impl; split so the 10k-participant
+    configs can run each phase as its own program (la/fd then cross the
+    boundary as donated arguments instead of XLA remat-copy temps —
+    one such copy was 3.8 GB at 10k x 100k)."""
+    if fd_mode in ("walk", "absorb", "fast"):
+        state = _rounds_frontier(state, cfg)
+    else:
+        slot_sched = _slot_sched(
+            state.n_events - batch.k, cfg, batch.sched
+        )
         state = _rounds_level_scan(state, cfg, slot_sched, batch.sched)
-    return _reset_event_sentinels(state, cfg)
+    return _reset_round_sentinels(state, cfg)
+
+
+def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch) -> DagState:
+    """Ingest a topologically-ordered batch of events end to end.
+
+    fd_mode:
+    - 'incremental' — O(K·E) fd min-scatter + level-scan rounds (live
+      gossip path; small batches, shallow schedules).
+    - 'full'        — chain-view fd searchsorted + level-scan rounds.
+    - 'fast'        — chain-view fd + per-round frontier rounds (the
+      batch/simulation path; identical outputs, differentially tested).
+    - 'walk'        — like 'fast' but la is filled by the Pallas
+      sequential-walk kernel (pallas_ingest.la_walk) instead of the level
+      scan: one in-VMEM pass over the slot order, ~1.8x faster than the
+      ~3,500-launch scan at 64x65k.  Gated by walk_supported().
+    - 'absorb'      — like 'fast' but with log-depth la self-absorption
+      instead of the level scan; gather-bound on current XLA — superseded
+      by 'walk'.
+    """
+    state = ingest_coords_impl(cfg, state, fd_mode, batch)
+    return ingest_rounds_impl(cfg, state, fd_mode, batch)
 
 
 ingest = jax.jit(ingest_impl, static_argnums=(0, 2), donate_argnums=(1,))

@@ -31,11 +31,17 @@ from .state import FAME_TRUE, FAME_UNDEFINED, INT32_MAX, DagConfig, DagState, I3
 
 INT64_MAX = jnp.iinfo(jnp.int64).max
 
+# e1*n element count above which the median computation chunks the event
+# axis (the [E, N] i64 tv tensor + sort double would be ~8 GB each at
+# 10k x 100k).  Module-level so tests can force the chunked branch small.
+MEDIAN_CHUNK_THRESHOLD = 1 << 28
+MEDIAN_CHUNK_ELEMS = 1 << 26
 
-def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
-    """Unjitted body — composable under an outer jit; see fame.decide_fame_impl."""
-    n, R, e1 = cfg.n, cfg.r_cap, cfg.e_cap + 1
 
+def order_tables(cfg: DagConfig, state: DagState):
+    """Small per-round tables the round-received scan reads (shared with
+    ops/wide.py's host-driven form)."""
+    R = cfg.r_cap
     wsl = state.wslot[:R]
     valid_w = wsl >= 0
     ws = sanitize(wsl, cfg.e_cap)
@@ -44,69 +50,126 @@ def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
     decided = ((~valid_w) | (state.famous[:R] != FAME_UNDEFINED)).all(axis=1)
     has_w = valid_w.any(axis=1)
     fam_cnt = fam.sum(axis=1)                              # [R]
+    return seqw, fam, decided, has_w, fam_cnt
 
+
+def order_rr_round(cfg, state, tables, und, i, rr):
+    """One round's round-received update: events received in round
+    i_abs = i + r_off when >1/2 of its famous witnesses see them."""
+    seqw, fam, decided, has_w, fam_cnt = tables
+    # table row i holds absolute round i_abs (rolling round window);
+    # i_abs >= 1 is implied by i_abs > round(x) >= 0 for valid events
+    i_abs = i + state.r_off
+    active = decided[i] & has_w[i] & (i_abs <= state.max_round)
+    sees = fam[i][None, :] & (state.fd <= seqw[i][None, :])      # [E+1, N]
+    c = sees.sum(axis=1)
+    cond = (
+        und
+        & (rr == -1)
+        & (i_abs > state.round)
+        & active
+        & (c > fam_cnt[i] // 2)
+    )
+    return jnp.where(cond, i_abs, rr)
+
+
+def order_undetermined(cfg: DagConfig, state: DagState):
+    e1 = cfg.e_cap + 1
     valid_e = (jnp.arange(e1) < state.n_events) & (state.seq >= 0)
-    und = valid_e & (state.rr == -1)
+    return valid_e & (state.rr == -1)
+
+
+def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
+    """Unjitted body — composable under an outer jit; see fame.decide_fame_impl."""
+    n, R, e1 = cfg.n, cfg.r_cap, cfg.e_cap + 1
+
+    tables = order_tables(cfg, state)
+    seqw, fam = tables[0], tables[1]
+    und = order_undetermined(cfg, state)
 
     def step(i, rr):
-        # table row i holds absolute round i_abs (rolling round window);
-        # i_abs >= 1 is implied by i_abs > round(x) >= 0 for valid events
-        i_abs = i + state.r_off
-        active = decided[i] & has_w[i] & (i_abs <= state.max_round)
-        sees = fam[i][None, :] & (state.fd <= seqw[i][None, :])      # [E+1, N]
-        c = sees.sum(axis=1)
-        cond = (
-            und
-            & (rr == -1)
-            & (i_abs > state.round)
-            & active
-            & (c > fam_cnt[i] // 2)
-        )
-        return jnp.where(cond, i_abs, rr)
+        return order_rr_round(cfg, state, tables, und, i, rr)
 
     rr = jax.lax.fori_loop(0, R, step, state.rr)
     newly = und & (rr != -1)
 
     # consensus timestamps for newly-received events
     i_of = jnp.clip(rr - state.r_off, 0, R - 1)
-    fam_i = fam[i_of]                                      # [E+1, N]
-    seqw_i = seqw[i_of]                                    # [E+1, N]
-    sees_i = fam_i & (state.fd <= seqw_i)                  # [E+1, N]
 
-    # tv[x, j] = timestamp of chain j's event at seq fd[x, j] (the oldest
-    # self-ancestor of witness j to see x).  A direct ts[ce[j, fd[x, j]]]
-    # double-gather scalarizes on TPU (~2 E·N elements at ~20 ns each — 3 s
-    # at 1024x100k); instead gather the small per-chain timestamp grid once
-    # and resolve the per-event lookup as an S-step select-accumulate, which
-    # is pure vectorized VPU work.
+    if e1 * n <= MEDIAN_CHUNK_THRESHOLD:
+        med = order_median_rows(cfg, state, seqw, fam, state.fd, i_of)
+    else:
+        # large-E shapes (e.g. 1024 x 300k under the fused pipeline): the
+        # [E, N] i64 tv tensor and its sort double would be several GB —
+        # chunk the event axis so each block's working set stays in the
+        # hundreds of MB.  fd is padded ONCE to a chunk multiple and read
+        # with aligned axis-0 dynamic_slices: a row *gather* from the
+        # loop-invariant fd inside lax.map would make XLA keep a
+        # layout-transposed copy of the whole tensor (the ops/wide.py
+        # lesson), and a clamped ragged-tail slice would misalign rows.
+        chunk = max(1, MEDIAN_CHUNK_ELEMS // n)
+        ep = -(-e1 // chunk) * chunk
+        fd_p = state.fd
+        i_of_p = i_of
+        if ep != e1:
+            fd_p = jnp.concatenate(
+                [fd_p, jnp.full((ep - e1, n), INT32_MAX, I32)], axis=0
+            )
+            i_of_p = jnp.concatenate(
+                [i_of_p, jnp.zeros((ep - e1,), i_of.dtype)]
+            )
+
+        def med_chunk(e0):
+            fd_c = jax.lax.dynamic_slice(fd_p, (e0, 0), (chunk, n))
+            i_c = jax.lax.dynamic_slice(i_of_p, (e0,), (chunk,))
+            return order_median_rows(cfg, state, seqw, fam, fd_c, i_c)
+
+        med = jax.lax.map(
+            med_chunk, jnp.arange(0, ep, chunk)
+        ).reshape(-1)[:e1]
+
+    cts = jnp.where(newly, med, state.cts)
+    return state._replace(rr=rr, cts=cts)
+
+
+def order_median_rows(cfg, state, seqw, fam, fd_rows, i_rows):
+    """Median consensus timestamp for a block of event rows.
+
+    tv[x, j] = timestamp of chain j's event at seq fd[x, j] (the oldest
+    self-ancestor of witness j to see x).  A direct ts[ce[j, fd[x, j]]]
+    double-gather scalarizes on TPU (~2 E·N elements at ~20 ns each — 3 s
+    at 1024x100k); instead gather the small per-chain timestamp grid once
+    and resolve the per-event lookup as an S-step select-accumulate,
+    which is pure vectorized VPU work."""
+    n = cfg.n
     cej = state.ce[:n]                                     # [N, S+1]
     ts_grid = state.ts[sanitize(cej, cfg.e_cap)]           # i64[N, S+1]
-    # fd values are absolute seqs; the grid columns are window-local
-    fdc = jnp.clip(state.fd - state.s_off[None, :n], 0, cfg.s_cap)
+    select_accumulate = jax.default_backend() == "tpu" and cfg.s_cap < 2048
 
-    if jax.default_backend() == "tpu" and cfg.s_cap < 2048:
-        # TPU, short chains: per-element gathers scalarize (~26 ns each),
-        # so an S-step select-accumulate in vectorized VPU work wins
-        # (measured 0.5 s vs 3.1 s at 1024x100k S=131; still ahead by
-        # ~60 ms at 64x65k S=1107)
+    rows = fd_rows.shape[0]
+    sees_rows = fam[i_rows] & (fd_rows <= seqw[i_rows])
+    # fd values are absolute seqs; the grid columns are window-local
+    fdc = jnp.clip(fd_rows - state.s_off[None, :n], 0, cfg.s_cap)
+    if select_accumulate:
+        # TPU, short chains: per-element gathers scalarize (~26 ns
+        # each), so an S-step select-accumulate in vectorized VPU
+        # work wins (measured 0.5 s vs 3.1 s at 1024x100k S=131;
+        # still ahead by ~60 ms at 64x65k S=1107)
         def acc_step(s, acc):
             return jnp.where(fdc == s, ts_grid[:, s][None, :], acc)
 
         tv = jax.lax.fori_loop(
             0, cfg.s_cap + 1, acc_step,
-            jnp.full((e1, n), INT64_MAX, dtype=state.ts.dtype),
+            jnp.full((rows, n), INT64_MAX, dtype=state.ts.dtype),
         )
     else:
         # long chains (select cost scales with S: 34.7 s vs 6.7 s at
         # 256x1M, S=4106) and CPU backends: the real gather wins
         tv = ts_grid[jnp.arange(n)[None, :], fdc]
-    tv = jnp.where(sees_i, tv, INT64_MAX)
+    tv = jnp.where(sees_rows, tv, INT64_MAX)
     tv_sorted = jnp.sort(tv, axis=1)
-    cnt_s = sees_i.sum(axis=1)
-    med = tv_sorted[jnp.arange(e1), jnp.clip(cnt_s // 2, 0, n - 1)]
-
-    cts = jnp.where(newly, med, state.cts)
-    return state._replace(rr=rr, cts=cts)
+    cnt_s = sees_rows.sum(axis=1)
+    return tv_sorted[jnp.arange(rows), jnp.clip(cnt_s // 2, 0, n - 1)]
 
 
 decide_order = jax.jit(decide_order_impl, static_argnums=(0,), donate_argnums=(1,))

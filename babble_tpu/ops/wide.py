@@ -1,0 +1,261 @@
+"""Host-orchestrated consensus pipeline for wide participant axes.
+
+Why this exists (the 10k-participant lesson, measured on v5e):
+
+XLA:TPU keeps a layout-transposed copy of a gather *operand* whenever the
+gather sits inside a device loop (while/scan/fori) and the operand is
+loop-invariant — hoisting turns even an unchanged loop carry back into an
+invariant.  The la/fd coordinate tensors are [E+1, N] = 3.7 GB each at
+10k x 100k, and every consensus loop (frontier march, fame voting, median
+chunking) gathers witness/candidate rows from them: the fused single-jit
+pipeline therefore carries +7.5 GB of hidden copies and OOMs a 16 GB
+chip.  Plain gathers in straight-line programs do NOT pay this (probed:
+a no-loop gather of the same shape compiles and runs fine).
+
+So at wide N the loops move to the host — the idiomatic JAX "step
+function + host loop" shape, like a training loop:
+
+    coords (1 jit)  ->  frontier march (host loop of round steps)
+                    ->  fame voting   (host loop of per-round vote steps)
+                    ->  order         (host loop: rr rounds, median chunks)
+
+Every step is a straight-line jitted program built from the SAME math as
+the fused pipeline (ops.ingest.frontier_step_math, ops.fame.fame_vote_math,
+ops.order.order_rr_round/order_median_rows) — bit-parity with the fused
+form is asserted in tests/test_wide.py.  Loop-control scalars (alive
+flags, undecided counts) sync to the host once per step; a full 10k x
+100k run makes ~40 dispatches, noise next to the kernel runtimes.
+
+The ~1 GB fused/wide crossover is fame_mode()'s threshold; wide_wins()
+applies the same bound to the whole pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fame as fame_ops
+from . import ingest as ingest_ops
+from . import order as order_ops
+from .ingest import EventBatch
+from .state import DagConfig, DagState, I32, init_state
+
+
+def wide_wins(cfg: DagConfig) -> bool:
+    """Same working-set bound as ops.fame.fame_mode."""
+    return fame_ops.fame_mode(cfg) == "block"
+
+
+@functools.lru_cache(maxsize=8)
+def _jits(cfg: DagConfig, fd_mode: str):
+    """Per-config jitted step programs (cfg is hashable + static)."""
+
+    coords = jax.jit(
+        functools.partial(ingest_ops.ingest_coords_impl, cfg,
+                          fd_mode=fd_mode),
+        donate_argnums=(0,),
+    )
+
+    def _frontier_step(state, r, pos, pos_table):
+        return ingest_ops.frontier_step_math(state, cfg, r, pos, pos_table)
+
+    frontier_step = jax.jit(_frontier_step, donate_argnums=(2, 3))
+
+    def _frontier_init(state):
+        return ingest_ops.frontier_init(state, cfg)
+
+    def _frontier_fin(state, pos_table):
+        state = ingest_ops.frontier_finalize(state, cfg, pos_table)
+        return ingest_ops._reset_round_sentinels(state, cfg)
+
+    frontier_fin = jax.jit(_frontier_fin, donate_argnums=(0,))
+
+    def _fame_init(state, famous_tab, i):
+        votes0, famous_i, valid_i = fame_ops.fame_round_init(
+            cfg, state, i, famous_tab
+        )
+        und = (famous_i == fame_ops.FAME_UNDEFINED) & valid_i
+        return votes0, famous_i, valid_i, und.any()
+
+    fame_init = jax.jit(_fame_init)
+
+    def _fame_step(state, i, d, votes, famous_i, valid_i):
+        votes, famous_i = fame_ops.fame_vote_math(
+            cfg, state, i, d, votes, famous_i, valid_i, True
+        )
+        und = (famous_i == fame_ops.FAME_UNDEFINED) & valid_i
+        return votes, famous_i, und.any()
+
+    # donate ONLY buffers created inside this host loop (votes, 400 MB at
+    # 10k).  Never donate anything still referenced through `state` — a
+    # donated buffer inside a later-passed pytree is a use-after-free.
+    fame_step = jax.jit(_fame_step, donate_argnums=(3,))
+
+    def _fame_write(famous_tab, famous_i, i):
+        return jax.lax.dynamic_update_slice_in_dim(
+            famous_tab, famous_i[None, :], i, 0
+        )
+
+    fame_write = jax.jit(_fame_write)
+
+    def _fame_fin(state, famous_out):
+        return fame_ops.fame_advance_lcr(cfg, state, famous_out)
+
+    fame_fin = jax.jit(_fame_fin)
+
+    def _order_prep(state):
+        tables = order_ops.order_tables(cfg, state)
+        und = order_ops.order_undetermined(cfg, state)
+        return tables, und
+
+    order_prep = jax.jit(_order_prep)
+
+    def _order_rr(state, tables, und, i, rr):
+        return order_ops.order_rr_round(cfg, state, tables, und, i, rr)
+
+    # rr/cts are [E+1] vectors (~1 MB): cheaper to copy than to reason
+    # about donating buffers aliased into `state`
+    order_rr = jax.jit(_order_rr)
+
+    chunk = max(1, order_ops.MEDIAN_CHUNK_ELEMS // cfg.n)
+
+    def _order_med_chunk(state, seqw, fam, i_of, newly, e0, cts):
+        idx = jnp.clip(e0 + jnp.arange(chunk), 0, cfg.e_cap)
+        med = order_ops.order_median_rows(
+            cfg, state, seqw, fam, state.fd[idx], i_of[idx]
+        )
+        upd = jnp.where(newly[idx], med, cts[idx])
+        return cts.at[idx].set(upd)
+
+    order_med_chunk = jax.jit(_order_med_chunk)
+
+    return dict(
+        coords=coords, frontier_init=jax.jit(_frontier_init),
+        frontier_step=frontier_step, frontier_fin=frontier_fin,
+        fame_init=fame_init, fame_step=fame_step, fame_write=fame_write,
+        fame_fin=fame_fin, order_prep=order_prep, order_rr=order_rr,
+        order_med_chunk=order_med_chunk, med_chunk_rows=chunk,
+    )
+
+
+def _assert_fresh(state: DagState) -> None:
+    """The wide pipeline is batch-only: it uses the one-hot strongly-see
+    (window-local seq invariant) and indexes witness rows by absolute
+    round, so rolled-window states are out of contract (the live engine
+    drives the fused kernels with batch_window=False instead)."""
+    if int(state.r_off) != 0:
+        raise ValueError(
+            "wide pipeline requires a fresh (un-compacted) state; "
+            f"got r_off={int(state.r_off)}"
+        )
+
+
+def run_wide_rounds(cfg: DagConfig, state: DagState,
+                    fd_mode: str = "fast") -> DagState:
+    """Host-driven frontier march (device twin: _rounds_frontier)."""
+    _assert_fresh(state)
+    j = _jits(cfg, fd_mode)
+    pos, pos_table = j["frontier_init"](state)
+    r = 0
+    alive = True
+    while alive and r < cfg.r_cap - 1:
+        pos, pos_table, any_next = j["frontier_step"](
+            state, jnp.asarray(r, I32), pos, pos_table
+        )
+        alive = bool(any_next)        # host sync, once per round
+        r += 1
+    return j["frontier_fin"](state, pos_table)
+
+
+def run_wide_fame(cfg: DagConfig, state: DagState,
+                  fd_mode: str = "fast") -> DagState:
+    """Host-driven fame voting (device twin: decide_fame_block_impl)."""
+    _assert_fresh(state)
+    j = _jits(cfg, fd_mode)
+    lcr = int(state.lcr)
+    max_round = int(state.max_round)
+    r_off = int(state.r_off)
+    famous = state.famous
+    for i_abs in range(max(lcr + 1, 0), max_round):
+        i = i_abs - r_off
+        votes, famous_i, valid_i, und_any = j["fame_init"](
+            state, famous, jnp.asarray(i, I32)
+        )
+        d = 2
+        while bool(und_any) and i_abs + d <= max_round:
+            votes, famous_i, und_any = j["fame_step"](
+                state, jnp.asarray(i, I32), jnp.asarray(d, I32),
+                votes, famous_i, valid_i,
+            )
+            d += 1
+        famous = j["fame_write"](famous, famous_i, jnp.asarray(i, I32))
+    state = state._replace(famous=famous)
+    return state._replace(lcr=j["fame_fin"](state, famous))
+
+
+def run_wide_order(cfg: DagConfig, state: DagState,
+                   fd_mode: str = "fast") -> DagState:
+    """Host-driven round-received + median timestamps (device twin:
+    decide_order_impl)."""
+    _assert_fresh(state)
+    j = _jits(cfg, fd_mode)
+    tables, und = j["order_prep"](state)
+    seqw, fam = tables[0], tables[1]
+    rr = state.rr
+    for i in range(cfg.r_cap):
+        rr = j["order_rr"](state, tables, und, jnp.asarray(i, I32), rr)
+    newly = und & (rr != -1)
+    i_of = jnp.clip(rr - state.r_off, 0, cfg.r_cap - 1)
+    cts = state.cts
+    chunk = j["med_chunk_rows"]
+    e1 = cfg.e_cap + 1
+    for e0 in range(0, e1, chunk):
+        cts = j["order_med_chunk"](
+            state, seqw, fam, i_of, newly, jnp.asarray(e0, I32), cts
+        )
+    return state._replace(rr=rr, cts=cts)
+
+
+def run_wide_pipeline(
+    cfg: DagConfig,
+    batch: EventBatch,
+    state: Optional[DagState] = None,
+    fd_mode: str = "fast",
+    timings: Optional[dict] = None,
+) -> DagState:
+    """Full batch pipeline at wide N: coords -> rounds -> fame -> order.
+
+    ``timings``, if given, receives per-phase wall seconds (the hook the
+    bench's MFU accounting uses)."""
+    import time
+
+    def tick(name, t0):
+        if timings is not None:
+            timings[name] = timings.get(name, 0.0) + time.perf_counter() - t0
+
+    j = _jits(cfg, fd_mode)
+    if state is None:
+        state = init_state(cfg)
+        jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    state = j["coords"](state, batch=batch)
+    _ = np.asarray(state.n_events)    # hard sync for honest phase timing
+    tick("coords", t0)
+    t0 = time.perf_counter()
+    state = run_wide_rounds(cfg, state, fd_mode)
+    _ = np.asarray(state.max_round)
+    tick("rounds", t0)
+    t0 = time.perf_counter()
+    state = run_wide_fame(cfg, state, fd_mode)
+    _ = np.asarray(state.lcr)
+    tick("fame", t0)
+    t0 = time.perf_counter()
+    state = run_wide_order(cfg, state, fd_mode)
+    _ = np.asarray(state.rr[:1])
+    tick("order", t0)
+    return state

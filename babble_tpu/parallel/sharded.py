@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..ops.fame import decide_fame_impl
+from ..ops.fame import decide_fame_auto_impl
 from ..ops.ingest import EventBatch, ingest_impl
 from ..ops.order import decide_order_impl
 from ..ops.state import DagConfig, DagState, init_state
@@ -90,14 +90,21 @@ def pad_cfg_for_mesh(cfg: DagConfig, mesh: Mesh) -> DagConfig:
 
 
 def consensus_step_impl(
-    cfg: DagConfig, fd_mode: str, state: DagState, batch: EventBatch
+    cfg: DagConfig, fd_mode: str, state: DagState, batch: EventBatch,
+    batch_window: bool = True,
 ) -> DagState:
     """The full step: ingest a gossip batch, then run the whole consensus
     pipeline (DivideRounds ≡ ingest's round scan, DecideFame, FindOrder's
     device half).  This is the framework's 'training step' — the unit the
-    multichip dry-run jits over a mesh."""
+    multichip dry-run jits over a mesh.
+
+    ``batch_window`` (static) asserts the all-window-offsets-zero
+    invariant of fresh batch states, which lets wide-N fame use the
+    one-hot MXU strongly-see (ops/ss.py).  A rolled-window caller (none
+    exists today — the live engine drives its own phase calls with
+    batch_window=False) MUST pass False here or wide-N fame miscounts."""
     state = ingest_impl(cfg, state, fd_mode, batch)
-    state = decide_fame_impl(cfg, state)
+    state = decide_fame_auto_impl(cfg, state, batch_window)
     state = decide_order_impl(cfg, state)
     return state
 

@@ -1,0 +1,158 @@
+"""Blockwise DecideFame + blocked strongly-see primitives (ops/ss.py).
+
+The 10k-participant north-star config cannot materialize the diagonal
+fame scan's [R, N, N] witness tensors (VERDICT r2 missing #1); these
+tests pin the blockwise replacements to the originals bit-for-bit:
+
+- ss_counts_onehot (int8 MXU formulation) == ss_counts_compare on
+  adversarial value patterns (sentinels, INF, out-of-band),
+- decide_fame_block_impl == decide_fame_impl across random gossip DAGs
+  (consensus-observable parity, including lcr),
+- the chunked decide_order median path == the unchunked one.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from babble_tpu.ops import ingest as ingest_ops
+from babble_tpu.ops.fame import (
+    decide_fame_block_impl,
+    decide_fame_impl,
+    fame_mode,
+)
+from babble_tpu.ops.order import decide_order_impl
+from babble_tpu.ops.ss import ss_counts_compare, ss_counts_onehot
+from babble_tpu.ops.state import (
+    INT32_MAX,
+    DagConfig,
+    assert_consensus_parity,
+    init_state,
+)
+from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
+
+def _ref_counts(la, fd):
+    return (la[:, None, :] >= fd[None, :, :]).sum(-1).astype(np.int32)
+
+
+@pytest.mark.parametrize("shape", [(7, 5, 9), (64, 64, 33), (130, 70, 257)])
+def test_ss_counts_formulations_agree(shape):
+    a, b, k = shape
+    s_hi = 13
+    rng = np.random.default_rng(a * 1000 + k)
+    la = rng.integers(-1, s_hi + 1, (a, k)).astype(np.int32)
+    fd = rng.integers(0, s_hi + 2, (b, k)).astype(np.int32)
+    # sprinkle INF ("no first descendant") entries
+    fd = np.where(rng.random((b, k)) < 0.15, INT32_MAX, fd)
+    ref = _ref_counts(la, fd)
+    got_c = np.asarray(ss_counts_compare(jnp.asarray(la), jnp.asarray(fd),
+                                         a_chunk=32))
+    got_o = np.asarray(ss_counts_onehot(jnp.asarray(la), jnp.asarray(fd),
+                                        s_hi, k_chunk_elems=1 << 9))
+    np.testing.assert_array_equal(got_c, ref)
+    np.testing.assert_array_equal(got_o, ref)
+
+
+def test_ss_counts_onehot_range_compression():
+    """With per-chain offsets, values far outside [0, s_hi] stay exact as
+    long as the *spread* fits the band."""
+    rng = np.random.default_rng(0)
+    a = b = 40
+    k = 25
+    base = rng.integers(0, 1000, (k,)).astype(np.int32)
+    la = (base[None, :] + rng.integers(-1, 8, (a, k))).astype(np.int32)
+    fd = (base[None, :] + rng.integers(0, 8, (b, k))).astype(np.int32)
+    fd = np.where(rng.random((b, k)) < 0.2, INT32_MAX, fd)
+    ref = _ref_counts(la, fd)
+    got = np.asarray(
+        ss_counts_onehot(jnp.asarray(la), jnp.asarray(fd), 8,
+                         off=jnp.asarray(base))
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize(
+    "n,e,r_cap,seed",
+    [(8, 200, 32, 1), (16, 500, 32, 2), (32, 2000, 64, 3), (5, 60, 16, 5)],
+)
+def test_blockwise_fame_parity(n, e, r_cap, seed):
+    dag = random_gossip_arrays(n, e, seed=seed)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=r_cap)
+
+    def run(fame_fn):
+        st = ingest_ops.ingest_impl(cfg, init_state(cfg), "fast", batch)
+        st = fame_fn(cfg, st)
+        st = decide_order_impl(cfg, st)
+        return st
+
+    ref = jax.jit(functools.partial(run, decide_fame_impl))()
+    blk = jax.jit(functools.partial(run, decide_fame_block_impl))()
+    assert_consensus_parity(ref, blk, e, label=f"blockfame n={n}")
+    assert int(ref.lcr) >= 0 or e < 100  # the DAGs actually decide fame
+
+
+def test_fame_mode_dispatch():
+    assert fame_mode(DagConfig(n=1024, e_cap=100_000, s_cap=131,
+                               r_cap=16)) == "diag"
+    assert fame_mode(DagConfig(n=10_000, e_cap=100_000, s_cap=32,
+                               r_cap=8)) == "block"
+
+
+def test_blockwise_fame_sharded_parity(monkeypatch):
+    """Force the block fame path under the 8-device ('ev','p') mesh and
+    pin it to the single-device run bit-for-bit — the while_loop +
+    dynamic-gather SPMD shape differs from the diag einsum the sharding
+    annotations were written for, so the dispatch boundary needs its own
+    mesh coverage."""
+    import babble_tpu.ops.fame as fame_mod
+    from babble_tpu.parallel import (
+        make_mesh, make_sharded_step, pad_cfg_for_mesh, sharded_init_state,
+    )
+    from babble_tpu.parallel.sharded import consensus_step_impl
+
+    monkeypatch.setattr(fame_mod, "BLOCK_FAME_THRESHOLD", 1)
+    assert fame_mod.fame_mode(DagConfig(n=8, e_cap=100, s_cap=16,
+                                        r_cap=8)) == "block"
+
+    n, e = 16, 400
+    dag = random_gossip_arrays(n, e, seed=11)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=32)
+    mesh = make_mesh(8)
+    cfg = pad_cfg_for_mesh(cfg, mesh)
+    step = make_sharded_step(cfg, mesh, "full")
+    sharded = step(sharded_init_state(cfg, mesh), batch)
+    ref = jax.jit(functools.partial(consensus_step_impl, cfg, "full"))(
+        init_state(cfg), batch
+    )
+    assert_consensus_parity(ref, sharded, int(ref.n_events),
+                            label="sharded blockfame")
+    assert int(ref.lcr) >= 0
+
+
+def test_chunked_order_median_parity(monkeypatch):
+    """Force the chunked median path at a small shape (with a ragged last
+    chunk) and pin it to the full-tensor path's output."""
+    import babble_tpu.ops.order as order_mod
+
+    n, e = 16, 500
+    dag = random_gossip_arrays(n, e, seed=9)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=32)
+    st = ingest_ops.ingest_impl(cfg, init_state(cfg), "fast", batch)
+    st = decide_fame_impl(cfg, st)
+    full = decide_order_impl(cfg, st)
+
+    monkeypatch.setattr(order_mod, "MEDIAN_CHUNK_THRESHOLD", 1)
+    monkeypatch.setattr(order_mod, "MEDIAN_CHUNK_ELEMS", 96 * n)  # ragged
+    chunked = decide_order_impl(cfg, st)
+    np.testing.assert_array_equal(np.asarray(full.cts)[:e],
+                                  np.asarray(chunked.cts)[:e])
+    np.testing.assert_array_equal(np.asarray(full.rr)[:e],
+                                  np.asarray(chunked.rr)[:e])
+    assert int((np.asarray(full.rr)[:e] >= 0).sum()) > 0

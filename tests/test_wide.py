@@ -1,0 +1,48 @@
+"""Host-orchestrated wide pipeline (ops/wide.py) vs the fused single-jit
+pipeline: bit-parity of every consensus-observable tensor.
+
+The wide form exists because gathers from loop-invariant [E, N] operands
+inside device loops cost hidden layout-transposed copies at 10k
+participants (see ops/wide.py docstring); these tests pin its math to
+the fused pipeline on shapes small enough to run both.
+"""
+
+import functools
+
+import jax
+import numpy as np
+import pytest
+
+from babble_tpu.ops.state import (
+    DagConfig,
+    assert_consensus_parity,
+    init_state,
+)
+from babble_tpu.ops.wide import run_wide_pipeline, wide_wins
+from babble_tpu.parallel.sharded import consensus_step_impl
+from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
+
+@pytest.mark.parametrize(
+    "n,e,r_cap,seed",
+    [(8, 200, 32, 1), (16, 500, 32, 2), (48, 3000, 64, 4)],
+)
+def test_wide_pipeline_parity(n, e, r_cap, seed):
+    dag = random_gossip_arrays(n, e, seed=seed)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=r_cap)
+
+    ref = jax.jit(functools.partial(consensus_step_impl, cfg, "fast"))(
+        init_state(cfg), batch
+    )
+    timings = {}
+    got = run_wide_pipeline(cfg, batch, timings=timings)
+    assert_consensus_parity(ref, got, e, label=f"wide n={n}")
+    assert set(timings) == {"coords", "rounds", "fame", "order"}
+    assert int((np.asarray(ref.rr)[:e] >= 0).sum()) > 0
+
+
+def test_wide_wins_dispatch():
+    assert not wide_wins(DagConfig(n=1024, e_cap=100_000, s_cap=131,
+                                   r_cap=16))
+    assert wide_wins(DagConfig(n=10_000, e_cap=100_000, s_cap=32, r_cap=8))
