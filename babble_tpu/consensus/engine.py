@@ -36,7 +36,6 @@ from ..ops import order as order_ops
 from ..ops.state import (
     FAME_TRUE,
     FAME_UNDEFINED,
-    INT32_MAX,
     DagConfig,
     DagState,
     bucket,
@@ -143,6 +142,17 @@ class TpuHashgraph:
         """Push pending host events through the device ingest pipeline."""
         if not self.dag.pending:
             return
+        if self.cfg.coord16:
+            # la/fd hold ABSOLUTE seqs, which compaction never rebases:
+            # int16 coordinates are only sound while every chain head is
+            # clear of the int16 INF sentinel (batch pipelines reset per
+            # run; a long-lived compacting engine eventually is not)
+            head = max((len(c) for c in self.dag.chains), default=0)
+            if head >= int(self.cfg.fd_inf) - 1:
+                raise OverflowError(
+                    f"coord16 engine exceeded int16 seq range (head seq "
+                    f"{head}); rebuild with coord16=False"
+                )
         batch, fd_mode = self.build_batch()
         self.state = ingest_ops.ingest(self.cfg, self.state, fd_mode, batch)
         self._view = {}
@@ -165,10 +175,7 @@ class TpuHashgraph:
         base = self.dag.slot_base
         while True:
             old_r_cap = self.cfg.r_cap
-            new_cfg = DagConfig(
-                n=self.cfg.n, e_cap=self.cfg.e_cap, s_cap=self.cfg.s_cap,
-                r_cap=old_r_cap * 2, n_real=self.cfg.n_real,
-            )
+            new_cfg = self.cfg._replace(r_cap=old_r_cap * 2)
             self.state = grow_state(self.state, self.cfg, new_cfg)
             self.cfg = new_cfg
             self._view = {}
@@ -261,10 +268,7 @@ class TpuHashgraph:
         while need_r >= r_cap:
             r_cap *= 2
         if (e_cap, s_cap, r_cap) != (cfg.e_cap, cfg.s_cap, cfg.r_cap):
-            new_cfg = DagConfig(
-                n=cfg.n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap,
-                n_real=cfg.n_real,
-            )
+            new_cfg = cfg._replace(e_cap=e_cap, s_cap=s_cap, r_cap=r_cap)
             self.state = grow_state(self.state, cfg, new_cfg)
             self.cfg = new_cfg
             self._view = {}
@@ -493,7 +497,7 @@ class TpuHashgraph:
         ex = self._event_at(sx)
         j = self.participants[ex.creator]
         f = int(fd[sy, j])
-        if f <= ex.index and f != int(INT32_MAX):
+        if f <= ex.index and f < int(self.cfg.fd_inf):
             return self.dag.events[self.dag.chains[j][f]].hex()
         return ""
 

@@ -63,7 +63,9 @@ def decide_fame_impl(cfg: DagConfig, state: DagState) -> DagState:
     mbw = state.mbit[ws]                               # bool[R, N]
 
     # law rows of the *next* round, aligned to index r (sentinel -1 rows past end)
-    law_next = jnp.concatenate([law[1:], jnp.full((1, n, n), -1, I32)], axis=0)
+    law_next = jnp.concatenate(
+        [law[1:], jnp.full((1, n, n), -1, law.dtype)], axis=0
+    )
     valid_next = jnp.concatenate([valid_w[1:], jnp.zeros((1, n), bool)], axis=0)
 
     # ss_next[r, a, b]: witness a of round r+1 strongly sees witness b of round r

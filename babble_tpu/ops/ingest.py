@@ -57,7 +57,9 @@ class EventBatch(NamedTuple):
     sched: jnp.ndarray    # i32[T, B] batch positions grouped by level, -1 pad
 
 
-def _reset_coord_sentinels(state: DagState, cfg: DagConfig) -> DagState:
+def _reset_coord_sentinels(
+    state: DagState, cfg: DagConfig, include_coords: bool = True
+) -> DagState:
     """Restore the sentinel row/col of everything the *coords* phase
     writes (batch fields, la/fd, chain tables) — padding lanes dump
     writes there; gathers of missing refs must stay neutral.
@@ -78,18 +80,26 @@ def _reset_coord_sentinels(state: DagState, cfg: DagConfig) -> DagState:
     s_col = jnp.arange(s + 1) == s        # [S+1]
     setv = set_sentinel
 
-    return state._replace(
+    state = state._replace(
         sp=setv(state.sp, e_row, -1),
         op=setv(state.op, e_row, -1),
         creator=setv(state.creator, e_row, n),
         seq=setv(state.seq, e_row, -1),
         ts=setv(state.ts, e_row, 0),
         mbit=setv(state.mbit, e_row, False),
-        la=setv(state.la, e_row[:, None], -1),
-        fd=setv(state.fd, e_row[:, None], INT32_MAX),
         ce=setv(state.ce, n_row[:, None] | s_col[None, :], -1),
         cnt=setv(state.cnt, n_row, 0),
     )
+    if include_coords:
+        # the wide host-driven coords folds these two into the final
+        # la/fd level steps instead (include_coords=False): la/fd must
+        # not even be *arguments* of any other program, or the donated
+        # pass-through costs a flaky multi-GB copy
+        state = state._replace(
+            la=setv(state.la, e_row[:, None], -1),
+            fd=setv(state.fd, e_row[:, None], cfg.fd_inf),
+        )
+    return state
 
 
 def _reset_round_sentinels(state: DagState, cfg: DagConfig) -> DagState:
@@ -141,18 +151,53 @@ def _slot_sched(state_n0: jnp.ndarray, cfg: DagConfig, sched: jnp.ndarray) -> jn
     return jnp.where(sched >= 0, state_n0 + sched, cfg.e_cap)
 
 
-def _la_level_scan(state: DagState, cfg: DagConfig, slot_sched: jnp.ndarray) -> DagState:
-    """Fill last-ancestor rows one topological level at a time:
+def la_gather_rows(cfg: DagConfig, sp, op, creator, seq, la, idx):
+    """Read half of one la level step: parents' row max with own seq set.
+    ``idx`` are device slots (sentinel e_cap for padding lanes).  Split
+    from the scatter half so ops/wide.py can run them as separate
+    programs (gather+scatter of one donated operand in a single program
+    makes XLA copy-protect the whole tensor)."""
+    spx = sanitize(sp[idx], cfg.e_cap)
+    opx = sanitize(op[idx], cfg.e_cap)
+    rows = jnp.maximum(la[spx], la[opx])                     # [B, N]
+    own_col = jnp.clip(creator[idx], 0, cfg.n - 1)
+    return rows.at[jnp.arange(idx.shape[0]), own_col].set(
+        seq[idx].astype(rows.dtype)
+    )
+
+
+def la_step_math(cfg: DagConfig, sp, op, creator, seq, la, idx):
+    """One topological level of last-ancestor fill:
     la[x] = max(la[sp(x)], la[op(x)]) with own slot := own seq."""
-    n = cfg.n
+    return la.at[idx].set(
+        la_gather_rows(cfg, sp, op, creator, seq, la, idx)
+    )
+
+
+def fd_scatter_rows(cfg: DagConfig, sp, op, fd, idx, rows):
+    """Write half of one reversed fd level step: scatter-min the given
+    final fd rows into their parents' rows."""
+    spx = sanitize(sp[idx], cfg.e_cap)
+    opx = sanitize(op[idx], cfg.e_cap)
+    fd = fd.at[spx].min(rows)
+    return fd.at[opx].min(rows)
+
+
+def fd_step_math(cfg: DagConfig, sp, op, fd, idx):
+    """One *reversed* topological level of first-descendant fill:
+    scatter-min each event's final fd row into its parents' rows."""
+    return fd_scatter_rows(cfg, sp, op, fd, idx, fd[idx])
+
+
+def _la_level_scan(state: DagState, cfg: DagConfig, slot_sched: jnp.ndarray) -> DagState:
+    """Fill last-ancestor rows one topological level at a time (fused
+    lax.scan form; ops/wide.py drives la_step_math from a host loop at
+    wide N, where XLA double-buffers the multi-GB scan carry)."""
 
     def step(la, idx):
-        spx = sanitize(state.sp[idx], cfg.e_cap)
-        opx = sanitize(state.op[idx], cfg.e_cap)
-        rows = jnp.maximum(la[spx], la[opx])                     # [B, N]
-        own_col = jnp.clip(state.creator[idx], 0, n - 1)
-        rows = rows.at[jnp.arange(idx.shape[0]), own_col].set(state.seq[idx])
-        return la.at[idx].set(rows), None
+        return la_step_math(
+            cfg, state.sp, state.op, state.creator, state.seq, la, idx
+        ), None
 
     la, _ = jax.lax.scan(step, state.la, slot_sched)
     return state._replace(la=la)
@@ -165,7 +210,9 @@ def _fd_init_own(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
     # slots of the just-written batch: n_events already advanced by k
     slots = jnp.where(real, state.n_events - b.k + pos, cfg.e_cap)
     own_col = jnp.clip(b.creator, 0, cfg.n - 1)
-    return state._replace(fd=state.fd.at[slots, own_col].set(b.seq))
+    return state._replace(
+        fd=state.fd.at[slots, own_col].set(b.seq.astype(state.fd.dtype))
+    )
 
 
 def _fd_incremental(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
@@ -186,9 +233,10 @@ def _fd_incremental(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
     anc = la_b[:, cy] >= state.seq[None, :]                       # [K, E+1]
     anc = anc & valid_y[None, :] & real[:, None]
 
-    vals = jnp.where(anc, b.seq[:, None], INT32_MAX)              # [K, E+1]
+    cd = cfg.coord_dtype
+    vals = jnp.where(anc, b.seq[:, None].astype(cd), cfg.fd_inf)  # [K, E+1]
     c_dump = jnp.where(real, b.creator, cfg.n)
-    upd = jnp.full((cfg.e_cap + 1, cfg.n + 1), INT32_MAX, I32)
+    upd = jnp.full((cfg.e_cap + 1, cfg.n + 1), cfg.fd_inf, cd)
     upd = upd.at[:, c_dump].min(vals.T)
     return state._replace(fd=jnp.minimum(state.fd, upd[:, : cfg.n]))
 
@@ -211,17 +259,12 @@ def _fd_reverse_scan(
     cover the whole DAG (the 'fast'/'walk' batch modes); incremental and
     engine paths keep their own fd strategies."""
     def step(fd, idx):
-        rows = fd[idx]                                        # [B, N]
-        spx = sanitize(state.sp[idx], cfg.e_cap)
-        opx = sanitize(state.op[idx], cfg.e_cap)
-        fd = fd.at[spx].min(rows)
-        fd = fd.at[opx].min(rows)
-        return fd, None
+        return fd_step_math(cfg, state.sp, state.op, fd, idx), None
 
     fd, _ = jax.lax.scan(step, state.fd, slot_sched[::-1])
     # pad lanes dumped mins into the sentinel row; restore it
     e_row = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
-    return state._replace(fd=set_sentinel(fd, e_row, INT32_MAX))
+    return state._replace(fd=set_sentinel(fd, e_row, cfg.fd_inf))
 
 
 def _fd_full(state: DagState, cfg: DagConfig) -> DagState:
@@ -247,7 +290,7 @@ def _fd_full(state: DagState, cfg: DagConfig) -> DagState:
     # V[j, s, c] = la[chain_j[s], c], +INF past the chain tail so each
     # (j, c) column stays sorted along s.  s is a window-local position;
     # la values stay absolute seqs.
-    V = state.la[sanitize(cej, cfg.e_cap)]                       # [N, S+1, N]
+    V = state.la[sanitize(cej, cfg.e_cap)].astype(I32)           # [N, S+1, N]
     V = jnp.where(
         (s_idx[None, :] < cnt_w[:, None])[:, :, None], V, INT32_MAX
     )
@@ -271,16 +314,20 @@ def _fd_full(state: DagState, cfg: DagConfig) -> DagState:
     out = jnp.moveaxis(counts, 0, 2).reshape(n, n, tpad)[:, :, :t_total]
     found = out < cnt_w[:, None, None]
     # fd values are absolute seqs: window-local count + chain j's offset
-    out = jnp.where(found, out + s_off[:, None, None], INT32_MAX)
+    # INF must be the coordinate dtype's sentinel: a raw INT32_MAX
+    # would wrap to -1 under an int16 cast at the scatter below
+    out = jnp.where(
+        found, out + s_off[:, None, None], jnp.asarray(cfg.fd_inf, I32)
+    )
 
     # scatter back to event rows: fd[ce[c, t], j] = out[j, c, t]
-    out_ctj = out.transpose(1, 2, 0)                             # [N(c), T, N(j)]
+    out_ctj = out.transpose(1, 2, 0).astype(cfg.coord_dtype)     # [N(c), T, N(j)]
     tgt = jnp.where(
         s_idx[None, :] < cnt_w[:, None], cej, cfg.e_cap
     )                                                            # [N, S+1]
     fd_new = state.fd.at[tgt].set(out_ctj)
     e_row = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
-    return state._replace(fd=set_sentinel(fd_new, e_row, INT32_MAX))
+    return state._replace(fd=set_sentinel(fd_new, e_row, cfg.fd_inf))
 
 
 def _rounds_level_scan(
@@ -349,9 +396,9 @@ def _la_init_direct(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
     real = pos < b.k
     slots = jnp.where(real, state.n_events - b.k + pos, cfg.e_cap)
 
-    rows = jnp.full((kpad, cfg.n), -1, I32)
+    rows = jnp.full((kpad, cfg.n), -1, cfg.coord_dtype)
     own = jnp.clip(b.creator, 0, cfg.n - 1)
-    rows = rows.at[jnp.arange(kpad), own].max(b.seq)
+    rows = rows.at[jnp.arange(kpad), own].max(b.seq.astype(rows.dtype))
     # Missing parents (slot -1) must contribute nothing.  The sentinel row is
     # NOT trustworthy here: this runs right after _write_batch_fields, whose
     # padded lanes dumped zero-filled creator/seq into row e_cap — gathering
@@ -361,8 +408,8 @@ def _la_init_direct(state: DagState, cfg: DagConfig, b: EventBatch) -> DagState:
     opx = sanitize(b.op, cfg.e_cap)
     sp_c = jnp.clip(state.creator[spx], 0, cfg.n - 1)
     op_c = jnp.clip(state.creator[opx], 0, cfg.n - 1)
-    sp_seq = jnp.where(b.sp >= 0, state.seq[spx], -1)
-    op_seq = jnp.where(b.op >= 0, state.seq[opx], -1)
+    sp_seq = jnp.where(b.sp >= 0, state.seq[spx], -1).astype(rows.dtype)
+    op_seq = jnp.where(b.op >= 0, state.seq[opx], -1).astype(rows.dtype)
     rows = rows.at[jnp.arange(kpad), sp_c].max(sp_seq)
     rows = rows.at[jnp.arange(kpad), op_c].max(op_seq)
     # Padded lanes all dump into the sentinel row; their rows must stay -1.
@@ -465,9 +512,9 @@ def frontier_step_math(
     # (fd values are absolute seqs -> window-local positions)
     e_star = cej[rows, jnp.clip(s_star, 0, s_cap)]
     fde = state.fd[sanitize(jnp.where(found, e_star, -1), cfg.e_cap)]
-    inherit = fde.min(axis=0)                          # [N] absolute
+    inherit = fde.min(axis=0).astype(I32)              # [N] absolute
     inherit = jnp.where(
-        inherit == INT32_MAX, INT32_MAX, inherit - s_off
+        inherit >= int(cfg.fd_inf), INT32_MAX, inherit - s_off
     )
     pos_next = jnp.minimum(
         jnp.where(found, s_star, INT32_MAX), inherit
@@ -595,6 +642,7 @@ def ingest_coords_impl(
         )
         state = state._replace(
             la=unpack_la(cfg.e_cap, cfg.n, packed, state.n_events)
+            .astype(cfg.coord_dtype)
         )
         state = _fd_init_own(state, cfg, batch)
         slot_sched = _slot_sched(state.n_events - batch.k, cfg, batch.sched)

@@ -113,7 +113,8 @@ def decide_order_impl(cfg: DagConfig, state: DagState) -> DagState:
         i_of_p = i_of
         if ep != e1:
             fd_p = jnp.concatenate(
-                [fd_p, jnp.full((ep - e1, n), INT32_MAX, I32)], axis=0
+                [fd_p, jnp.full((ep - e1, n), cfg.fd_inf, fd_p.dtype)],
+                axis=0,
             )
             i_of_p = jnp.concatenate(
                 [i_of_p, jnp.zeros((ep - e1,), i_of.dtype)]

@@ -92,9 +92,10 @@ def ss_counts_onehot(
     B = fd_rows.shape[0]
     S1 = s_hi + 1
     if off is not None:
+        inf = jnp.iinfo(fd_rows.dtype).max   # dtype-generic INF sentinel
         la_rows = jnp.where(la_rows < 0, -1, la_rows - off[None, :])
         fd_rows = jnp.where(
-            fd_rows == INT32_MAX, INT32_MAX, fd_rows - off[None, :]
+            fd_rows >= inf, inf, fd_rows - off[None, :]
         )
     # la above the band satisfies every threshold; fd above the band must
     # be INF-only (count 0) -> dead bucket S1 (outside the iota range)

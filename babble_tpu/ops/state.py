@@ -46,15 +46,22 @@ class DagConfig(NamedTuple):
     ``n`` is the *array width* of the participant axis; when sharding pads
     that axis to the mesh (parallel/sharded.py), ``n_real`` holds the true
     participant count and thresholds (supermajority, coin-round period) use
-    it.  Padded columns hold sentinel coordinates (la=-1, fd=INT32_MAX) so
+    it.  Padded columns hold sentinel coordinates (la=-1, fd=inf) so
     they never contribute to any see/vote count.  n_real=0 means n is real.
-    """
+
+    ``coord16`` stores the la/fd coordinate tensors as int16 instead of
+    int32 — they are the dominant HBM residents ([E+1, N] each; 3.7 GB
+    at 10k x 100k in i32), and every value is a per-creator seq, bounded
+    by s_cap.  Halving them is what fits the deep 10k-participant
+    configs on one 16 GB chip.  Requires s_cap < 16384 (headroom below
+    the int16 INF sentinel); coord16_ok() checks."""
 
     n: int          # participants (array width, possibly mesh-padded)
     e_cap: int      # event slot capacity
     s_cap: int      # per-creator sequence capacity
     r_cap: int      # round capacity
     n_real: int = 0
+    coord16: bool = False
 
     @property
     def active_n(self) -> int:
@@ -63,6 +70,24 @@ class DagConfig(NamedTuple):
     @property
     def super_majority(self) -> int:
         return 2 * self.active_n // 3 + 1
+
+    @property
+    def coord_dtype(self):
+        return jnp.int16 if self.coord16 else I32
+
+    @property
+    def fd_inf(self):
+        """The 'no first descendant' sentinel, in coordinate dtype.
+        Compare with >= (never ==): arithmetic on INF-holding tensors
+        must stay on the safe side."""
+        return np.int16(np.iinfo(np.int16).max) if self.coord16 \
+            else INT32_MAX
+
+
+def coord16_ok(s_cap: int) -> bool:
+    """int16 coordinates are exact when every seq (plus slack for the
+    +1-ish arithmetic in the kernels) stays clear of the INF sentinel."""
+    return s_cap < (1 << 14)
 
 
 class DagState(NamedTuple):
@@ -118,6 +143,11 @@ class DagState(NamedTuple):
 
 
 def init_state(cfg: DagConfig) -> DagState:
+    if cfg.coord16 and not coord16_ok(cfg.s_cap):
+        raise ValueError(
+            f"coord16 requires s_cap < 2^14 (got {cfg.s_cap}): int16 "
+            "coordinates would wrap"
+        )
     e1, n, s1, r1 = cfg.e_cap + 1, cfg.n, cfg.s_cap + 1, cfg.r_cap + 1
     return DagState(
         sp=jnp.full((e1,), -1, I32),
@@ -126,8 +156,8 @@ def init_state(cfg: DagConfig) -> DagState:
         seq=jnp.full((e1,), -1, I32),
         ts=jnp.zeros((e1,), I64),
         mbit=jnp.zeros((e1,), jnp.bool_),
-        la=jnp.full((e1, n), -1, I32),
-        fd=jnp.full((e1, n), INT32_MAX, I32),
+        la=jnp.full((e1, n), -1, cfg.coord_dtype),
+        fd=jnp.full((e1, n), cfg.fd_inf, cfg.coord_dtype),
         round=jnp.full((e1,), -1, I32),
         witness=jnp.zeros((e1,), jnp.bool_),
         rr=jnp.full((e1,), -1, I32),
@@ -148,6 +178,7 @@ def init_state(cfg: DagConfig) -> DagState:
 def grow_state(state: DagState, old: DagConfig, new: DagConfig) -> DagState:
     """Copy arrays into larger-capacity buffers (sentinel rows preserved at
     the new last index).  Host-side, called rarely; triggers re-jit."""
+    assert old.coord16 == new.coord16, "cannot grow across coordinate dtypes"
     fresh = init_state(new)
 
     def copy_events(dst, src):

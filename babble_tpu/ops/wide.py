@@ -55,11 +55,73 @@ def wide_wins(cfg: DagConfig) -> bool:
 def _jits(cfg: DagConfig, fd_mode: str):
     """Per-config jitted step programs (cfg is hashable + static)."""
 
-    coords = jax.jit(
-        functools.partial(ingest_ops.ingest_coords_impl, cfg,
-                          fd_mode=fd_mode),
-        donate_argnums=(0,),
-    )
+    # Host-driven coords pieces.  Two wide-N memory rules, both measured
+    # as OOMs at 10k x 300k: (a) XLA double-buffers the multi-GB la/fd
+    # carries of the fused level scans, so each level is its own program
+    # with the coordinate tensor donated through (in-place); (b) a
+    # donated argument that merely PASSES THROUGH a program (la during
+    # the batch write, la+fd during round finalize) costs a flaky
+    # full-size copy — so la/fd are arguments ONLY of programs that
+    # read or write them, pruned from every other call via
+    # state._replace(la=None, ...) and reattached on the host.
+    e_row = jnp.arange(cfg.e_cap + 1) == cfg.e_cap
+
+    def _write_batch(state, batch):
+        state = ingest_ops._write_batch_fields(state, cfg, batch)
+        return ingest_ops._fd_init_own(state, cfg, batch)
+
+    write_batch = jax.jit(_write_batch, donate_argnums=(0,))
+
+    # Each level is a gather program (reads la/fd, no donation) + a
+    # scatter program (donated in-place write).  Gather AND scatter of
+    # the same donated operand in ONE program makes XLA copy-protect the
+    # whole tensor (it cannot prove the read rows and written rows are
+    # disjoint) — a +5.65 GB transient that OOMs at 10k x 300k, while a
+    # pure donated scatter aliases in place (probed).
+    from .state import set_sentinel
+
+    def _idx_of(row, base):
+        return jnp.where(row >= 0, base + row, cfg.e_cap)
+
+    def _la_gather(sp, op, creator, seq, la, row, base):
+        return ingest_ops.la_gather_rows(
+            cfg, sp, op, creator, seq, la, _idx_of(row, base)
+        )
+
+    la_gather = jax.jit(_la_gather)
+
+    def _la_scatter(la, row, base, rows, final):
+        la = la.at[_idx_of(row, base)].set(rows)
+        if final:   # sentinel-row restore folded into the last level
+            la = set_sentinel(la, e_row[:, None], -1)
+        return la
+
+    la_scatter = jax.jit(_la_scatter, donate_argnums=(0,),
+                         static_argnums=(4,))
+
+    def _fd_gather(fd, row, base):
+        return fd[_idx_of(row, base)]
+
+    fd_gather = jax.jit(_fd_gather)
+
+    def _fd_scatter(sp, op, fd, row, base, rows, final):
+        fd = ingest_ops.fd_scatter_rows(
+            cfg, sp, op, fd, _idx_of(row, base), rows
+        )
+        if final:
+            fd = set_sentinel(fd, e_row[:, None], cfg.fd_inf)
+        return fd
+
+    fd_scatter = jax.jit(_fd_scatter, donate_argnums=(2,),
+                         static_argnums=(6,))
+
+    def _coord_sent(state):
+        # called with la=None/fd=None in the pytree (rule (b) above)
+        return ingest_ops._reset_coord_sentinels(
+            state, cfg, include_coords=False
+        )
+
+    coord_sent = jax.jit(_coord_sent, donate_argnums=(0,))
 
     def _frontier_step(state, r, pos, pos_table):
         return ingest_ops.frontier_step_math(state, cfg, r, pos, pos_table)
@@ -70,6 +132,8 @@ def _jits(cfg: DagConfig, fd_mode: str):
         return ingest_ops.frontier_init(state, cfg)
 
     def _frontier_fin(state, pos_table):
+        # called with la=None/fd=None: frontier_finalize reads neither,
+        # and pass-through donated giants cost flaky full-size copies
         state = ingest_ops.frontier_finalize(state, cfg, pos_table)
         return ingest_ops._reset_round_sentinels(state, cfg)
 
@@ -135,7 +199,11 @@ def _jits(cfg: DagConfig, fd_mode: str):
     order_med_chunk = jax.jit(_order_med_chunk)
 
     return dict(
-        coords=coords, frontier_init=jax.jit(_frontier_init),
+        write_batch=write_batch,
+        la_gather=la_gather, la_scatter=la_scatter,
+        fd_gather=fd_gather, fd_scatter=fd_scatter,
+        coord_sent=coord_sent,
+        frontier_init=jax.jit(_frontier_init),
         frontier_step=frontier_step, frontier_fin=frontier_fin,
         fame_init=fame_init, fame_step=fame_step, fame_write=fame_write,
         fame_fin=fame_fin, order_prep=order_prep, order_rr=order_rr,
@@ -155,6 +223,35 @@ def _assert_fresh(state: DagState) -> None:
         )
 
 
+def run_wide_coords(cfg: DagConfig, state: DagState, batch: EventBatch,
+                    fd_mode: str = "fast") -> DagState:
+    """Host-driven coordinate fill (device twin: ingest_coords_impl with
+    fd_mode='fast'): write batch fields, then one jitted program per
+    topological level for the la forward scan and the fd reverse scan,
+    the coordinate tensor donated through each call."""
+    if fd_mode != "fast":
+        raise ValueError("wide coords supports the 'fast' batch mode only")
+    j = _jits(cfg, fd_mode)
+    la_keep = state.la
+    state = j["write_batch"](state._replace(la=None), batch)
+    state = state._replace(la=la_keep)
+    base = state.n_events - batch.k
+    sp, op, creator, seq = state.sp, state.op, state.creator, state.seq
+    T = batch.sched.shape[0]
+    la = state.la
+    for t in range(T):
+        row = batch.sched[t]
+        rows = j["la_gather"](sp, op, creator, seq, la, row, base)
+        la = j["la_scatter"](la, row, base, rows, t == T - 1)
+    fd = state.fd
+    for t in reversed(range(T)):
+        row = batch.sched[t]
+        rows = j["fd_gather"](fd, row, base)
+        fd = j["fd_scatter"](sp, op, fd, row, base, rows, t == 0)
+    state = j["coord_sent"](state._replace(la=None, fd=None))
+    return state._replace(la=la, fd=fd)
+
+
 def run_wide_rounds(cfg: DagConfig, state: DagState,
                     fd_mode: str = "fast") -> DagState:
     """Host-driven frontier march (device twin: _rounds_frontier)."""
@@ -169,7 +266,11 @@ def run_wide_rounds(cfg: DagConfig, state: DagState,
         )
         alive = bool(any_next)        # host sync, once per round
         r += 1
-    return j["frontier_fin"](state, pos_table)
+    la_keep, fd_keep = state.la, state.fd
+    state = j["frontier_fin"](
+        state._replace(la=None, fd=None), pos_table
+    )
+    return state._replace(la=la_keep, fd=fd_keep)
 
 
 def run_wide_fame(cfg: DagConfig, state: DagState,
@@ -238,12 +339,11 @@ def run_wide_pipeline(
         if timings is not None:
             timings[name] = timings.get(name, 0.0) + time.perf_counter() - t0
 
-    j = _jits(cfg, fd_mode)
     if state is None:
         state = init_state(cfg)
         jax.block_until_ready(state)
     t0 = time.perf_counter()
-    state = j["coords"](state, batch=batch)
+    state = run_wide_coords(cfg, state, batch, fd_mode)
     _ = np.asarray(state.n_events)    # hard sync for honest phase timing
     tick("coords", t0)
     t0 = time.perf_counter()

@@ -32,7 +32,7 @@ from ..consensus.engine import TpuHashgraph
 from ..core.event import Event, EventBody
 from ..ops.state import DagConfig, DagState
 
-FORMAT_VERSION = 2
+FORMAT_VERSION = 3
 
 _META = "meta.msgpack"
 _DEVICE = "device.npz"
@@ -147,7 +147,8 @@ def _expected_layout(cfg: DagConfig) -> Dict[str, tuple]:
     return {
         "sp": (ev, i32), "op": (ev, i32), "creator": (ev, i32),
         "seq": (ev, i32), "ts": (ev, i64), "mbit": (ev, b),
-        "la": ((e1, n), i32), "fd": ((e1, n), i32),
+        "la": ((e1, n), np.dtype(cfg.coord_dtype)),
+        "fd": ((e1, n), np.dtype(cfg.coord_dtype)),
         "round": (ev, i32), "witness": (ev, b), "rr": (ev, i32),
         "cts": (ev, i64),
         "ce": ((n + 1, s1), i32), "cnt": ((n + 1,), i32),
@@ -254,7 +255,8 @@ def _restore_engine(
     commit_callback: Optional[Callable] = None,
     policy: Optional[dict] = None,
 ) -> TpuHashgraph:
-    if meta["version"] != FORMAT_VERSION:
+    # v2 differs only by the missing coord16 cfg field (defaults False)
+    if meta["version"] not in (2, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     policy = policy or {}
 

@@ -1,0 +1,107 @@
+"""int16 coordinate tensors (DagConfig.coord16): bit-parity with int32.
+
+la/fd are the dominant HBM residents; coord16 halves them, which is what
+fits the deep 10k-participant configs on one 16 GB chip.  Every value is
+a per-creator seq bounded by s_cap, so int16 is exact when
+s_cap < 2^14 (coord16_ok) — these tests pin i16 == i32 across the fused
+pipeline, the wide host-driven pipeline, every fd strategy, and the
+checkpoint layout."""
+
+import functools
+
+import jax
+import numpy as np
+import pytest
+
+from babble_tpu.ops.state import (
+    DagConfig,
+    assert_consensus_parity,
+    coord16_ok,
+    init_state,
+)
+from babble_tpu.ops.wide import run_wide_pipeline
+from babble_tpu.parallel.sharded import consensus_step_impl
+from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
+
+def _parity_fields_equal(a, b, e):
+    for f in ("round", "witness", "rr", "cts"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f))[:e], np.asarray(getattr(b, f))[:e],
+            err_msg=f,
+        )
+    np.testing.assert_array_equal(np.asarray(a.famous), np.asarray(b.famous))
+    assert int(a.lcr) == int(b.lcr)
+    # coordinates agree as integers (dtypes differ by design)
+    np.testing.assert_array_equal(
+        np.asarray(a.la)[:e].astype(np.int32), np.asarray(b.la)[:e]
+    )
+    fa = np.asarray(a.fd)[:e].astype(np.int64)
+    fb = np.asarray(b.fd)[:e].astype(np.int64)
+    inf_a = np.iinfo(np.asarray(a.fd).dtype).max
+    inf_b = np.iinfo(np.asarray(b.fd).dtype).max
+    np.testing.assert_array_equal(fa == inf_a, fb == inf_b)
+    m = fa != inf_a
+    np.testing.assert_array_equal(fa[m], fb[m])
+
+
+@pytest.mark.parametrize("fd_mode", ["fast", "full", "incremental"])
+def test_coord16_fused_parity(fd_mode):
+    n, e = 16, 500
+    dag = random_gossip_arrays(n, e, seed=21)
+    batch = batch_from_arrays(dag)
+    base = dict(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=32)
+    cfg32 = DagConfig(**base)
+    cfg16 = DagConfig(**base, coord16=True)
+    assert coord16_ok(cfg16.s_cap)
+
+    out32 = jax.jit(functools.partial(consensus_step_impl, cfg32, fd_mode))(
+        init_state(cfg32), batch
+    )
+    out16 = jax.jit(functools.partial(consensus_step_impl, cfg16, fd_mode))(
+        init_state(cfg16), batch
+    )
+    _parity_fields_equal(out16, out32, e)
+    assert int(out32.lcr) >= 0
+
+
+def test_coord16_wide_parity():
+    n, e = 24, 1200
+    dag = random_gossip_arrays(n, e, seed=22)
+    batch = batch_from_arrays(dag)
+    base = dict(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=32)
+    cfg32 = DagConfig(**base)
+    cfg16 = DagConfig(**base, coord16=True)
+    out32 = jax.jit(functools.partial(consensus_step_impl, cfg32, "fast"))(
+        init_state(cfg32), batch
+    )
+    out16 = run_wide_pipeline(cfg16, batch)
+    _parity_fields_equal(out16, out32, e)
+
+
+def test_coord16_engine_and_checkpoint(tmp_path):
+    """Engine-level coord16 (incremental live path) + snapshot roundtrip."""
+    from babble_tpu.consensus.engine import TpuHashgraph
+    from babble_tpu.sim.generator import random_gossip_dag
+    from babble_tpu.store.checkpoint import load_checkpoint, save_checkpoint
+
+    dag = random_gossip_dag(7, 250, seed=5)
+    engines = {}
+    for c16 in (False, True):
+        eng = TpuHashgraph(dag.participants, verify_signatures=False,
+                           e_cap=512, s_cap=64, r_cap=32)
+        if c16:
+            eng.cfg = eng.cfg._replace(coord16=True)
+            eng.state = init_state(eng.cfg)
+        for ev in dag.events:
+            eng.insert_event(ev)
+        eng.run_consensus()
+        engines[c16] = eng
+    assert engines[True].consensus_events() == engines[False].consensus_events()
+    assert len(engines[True].consensus_events()) > 30
+
+    path = tmp_path / "snap.ckpt"
+    save_checkpoint(engines[True], str(path))
+    eng2 = load_checkpoint(str(path))
+    assert eng2.cfg.coord16 is True
+    assert eng2.consensus_events() == engines[True].consensus_events()
