@@ -142,16 +142,16 @@ class TpuHashgraph:
         """Push pending host events through the device ingest pipeline."""
         if not self.dag.pending:
             return
-        if self.cfg.coord16:
+        if self.cfg.coord16 or self.cfg.coord8:
             # la/fd hold ABSOLUTE seqs, which compaction never rebases:
-            # int16 coordinates are only sound while every chain head is
-            # clear of the int16 INF sentinel (batch pipelines reset per
-            # run; a long-lived compacting engine eventually is not)
+            # narrow coordinates are only sound while every chain head
+            # is clear of the dtype's INF sentinel (batch pipelines
+            # reset per run; a long-lived compacting engine is not)
             head = max((len(c) for c in self.dag.chains), default=0)
             if head >= int(self.cfg.fd_inf) - 1:
                 raise OverflowError(
-                    f"coord16 engine exceeded int16 seq range (head seq "
-                    f"{head}); rebuild with coord16=False"
+                    f"narrow-coordinate engine exceeded seq range (head seq "
+                    f"{head}); rebuild with wider coordinates"
                 )
         batch, fd_mode = self.build_batch()
         self.state = ingest_ops.ingest(self.cfg, self.state, fd_mode, batch)

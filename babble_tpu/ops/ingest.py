@@ -151,48 +151,37 @@ def _slot_sched(state_n0: jnp.ndarray, cfg: DagConfig, sched: jnp.ndarray) -> jn
     return jnp.where(sched >= 0, state_n0 + sched, cfg.e_cap)
 
 
-def la_gather_rows(cfg: DagConfig, sp, op, creator, seq, la, idx):
-    """Read half of one la level step: parents' row max with own seq set.
-    ``idx`` are device slots (sentinel e_cap for padding lanes).  Split
-    from the scatter half so ops/wide.py can run them as separate
-    programs (gather+scatter of one donated operand in a single program
-    makes XLA copy-protect the whole tensor)."""
+def la_step_math(cfg: DagConfig, sp, op, creator, seq, la, idx):
+    """One topological level of last-ancestor fill:
+    la[x] = max(la[sp(x)], la[op(x)]) with own slot := own seq.
+    ``idx`` are device slots (sentinel e_cap for padding lanes).
+    ops/wide.py's _la_block_scan is the column-blocked twin of this
+    recurrence (block-offset own-column handling; differentially
+    tested against this form)."""
     spx = sanitize(sp[idx], cfg.e_cap)
     opx = sanitize(op[idx], cfg.e_cap)
     rows = jnp.maximum(la[spx], la[opx])                     # [B, N]
     own_col = jnp.clip(creator[idx], 0, cfg.n - 1)
-    return rows.at[jnp.arange(idx.shape[0]), own_col].set(
+    rows = rows.at[jnp.arange(idx.shape[0]), own_col].set(
         seq[idx].astype(rows.dtype)
     )
+    return la.at[idx].set(rows)
 
 
-def la_step_math(cfg: DagConfig, sp, op, creator, seq, la, idx):
-    """One topological level of last-ancestor fill:
-    la[x] = max(la[sp(x)], la[op(x)]) with own slot := own seq."""
-    return la.at[idx].set(
-        la_gather_rows(cfg, sp, op, creator, seq, la, idx)
-    )
-
-
-def fd_scatter_rows(cfg: DagConfig, sp, op, fd, idx, rows):
-    """Write half of one reversed fd level step: scatter-min the given
-    final fd rows into their parents' rows."""
+def fd_step_math(cfg: DagConfig, sp, op, fd, idx):
+    """One *reversed* topological level of first-descendant fill:
+    scatter-min each event's final fd row into its parents' rows
+    (blocked twin: ops/wide.py _fd_block_scan)."""
+    rows = fd[idx]                                           # [B, N]
     spx = sanitize(sp[idx], cfg.e_cap)
     opx = sanitize(op[idx], cfg.e_cap)
     fd = fd.at[spx].min(rows)
     return fd.at[opx].min(rows)
 
 
-def fd_step_math(cfg: DagConfig, sp, op, fd, idx):
-    """One *reversed* topological level of first-descendant fill:
-    scatter-min each event's final fd row into its parents' rows."""
-    return fd_scatter_rows(cfg, sp, op, fd, idx, fd[idx])
-
-
 def _la_level_scan(state: DagState, cfg: DagConfig, slot_sched: jnp.ndarray) -> DagState:
     """Fill last-ancestor rows one topological level at a time (fused
-    lax.scan form; ops/wide.py drives la_step_math from a host loop at
-    wide N, where XLA double-buffers the multi-GB scan carry)."""
+    lax.scan form; the wide pipeline runs the column-blocked twin)."""
 
     def step(la, idx):
         return la_step_math(

@@ -62,6 +62,7 @@ class DagConfig(NamedTuple):
     r_cap: int      # round capacity
     n_real: int = 0
     coord16: bool = False
+    coord8: bool = False     # overrides coord16 (shallowest chains only)
 
     @property
     def active_n(self) -> int:
@@ -73,6 +74,8 @@ class DagConfig(NamedTuple):
 
     @property
     def coord_dtype(self):
+        if self.coord8:
+            return jnp.int8
         return jnp.int16 if self.coord16 else I32
 
     @property
@@ -80,14 +83,22 @@ class DagConfig(NamedTuple):
         """The 'no first descendant' sentinel, in coordinate dtype.
         Compare with >= (never ==): arithmetic on INF-holding tensors
         must stay on the safe side."""
-        return np.int16(np.iinfo(np.int16).max) if self.coord16 \
-            else INT32_MAX
+        return np.asarray(np.iinfo(np.dtype(self.coord_dtype)).max,
+                          np.dtype(self.coord_dtype))[()]
 
 
 def coord16_ok(s_cap: int) -> bool:
     """int16 coordinates are exact when every seq (plus slack for the
     +1-ish arithmetic in the kernels) stays clear of the INF sentinel."""
     return s_cap < (1 << 14)
+
+
+def coord8_ok(s_cap: int) -> bool:
+    """int8 coordinates: seqs (plus kernel slack) must stay below the
+    int8 INF sentinel 127.  At 10k participants a 600k-event gossip DAG
+    peaks near seq 90, so this covers the deep wide-bench configs —
+    which is exactly where the coordinate tensors dominate HBM."""
+    return s_cap < 120
 
 
 class DagState(NamedTuple):
@@ -142,8 +153,14 @@ class DagState(NamedTuple):
     r_off: jnp.ndarray     # i32      absolute round of wslot/famous row 0
 
 
-def init_state(cfg: DagConfig) -> DagState:
-    if cfg.coord16 and not coord16_ok(cfg.s_cap):
+def init_state(cfg: DagConfig,
+               include_coords: bool = True) -> DagState:
+    if cfg.coord8 and not coord8_ok(cfg.s_cap):
+        raise ValueError(
+            f"coord8 requires s_cap < 120 (got {cfg.s_cap}): int8 "
+            "coordinates would wrap"
+        )
+    if cfg.coord16 and not cfg.coord8 and not coord16_ok(cfg.s_cap):
         raise ValueError(
             f"coord16 requires s_cap < 2^14 (got {cfg.s_cap}): int16 "
             "coordinates would wrap"
@@ -156,8 +173,13 @@ def init_state(cfg: DagConfig) -> DagState:
         seq=jnp.full((e1,), -1, I32),
         ts=jnp.zeros((e1,), I64),
         mbit=jnp.zeros((e1,), jnp.bool_),
-        la=jnp.full((e1, n), -1, cfg.coord_dtype),
-        fd=jnp.full((e1, n), cfg.fd_inf, cfg.coord_dtype),
+        # include_coords=False: the blocked wide pipeline owns la/fd as
+        # column blocks; allocating the fused twins here would double the
+        # dominant residency before the blocks even exist
+        la=jnp.full((e1, n), -1, cfg.coord_dtype)
+        if include_coords else None,
+        fd=jnp.full((e1, n), cfg.fd_inf, cfg.coord_dtype)
+        if include_coords else None,
         round=jnp.full((e1,), -1, I32),
         witness=jnp.zeros((e1,), jnp.bool_),
         rr=jnp.full((e1,), -1, I32),
@@ -178,7 +200,11 @@ def init_state(cfg: DagConfig) -> DagState:
 def grow_state(state: DagState, old: DagConfig, new: DagConfig) -> DagState:
     """Copy arrays into larger-capacity buffers (sentinel rows preserved at
     the new last index).  Host-side, called rarely; triggers re-jit."""
-    assert old.coord16 == new.coord16, "cannot grow across coordinate dtypes"
+    if old.coord_dtype != new.coord_dtype:
+        raise ValueError(
+            "cannot grow across coordinate dtypes: values would be "
+            f"silently cast ({old.coord_dtype} -> {new.coord_dtype})"
+        )
     fresh = init_state(new)
 
     def copy_events(dst, src):

@@ -85,7 +85,7 @@ def pad_cfg_for_mesh(cfg: DagConfig, mesh: Mesh) -> DagConfig:
     n_real = cfg.n_real or cfg.n
     return DagConfig(
         n=n_pad, e_cap=e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
-        n_real=n_real, coord16=cfg.coord16,
+        n_real=n_real, coord16=cfg.coord16, coord8=cfg.coord8,
     )
 
 

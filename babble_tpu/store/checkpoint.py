@@ -258,6 +258,16 @@ def _restore_engine(
     # v2 differs only by the missing coord16 cfg field (defaults False)
     if meta["version"] not in (2, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
+    from ..ops.state import coord8_ok, coord16_ok
+    cfg_chk = DagConfig(*meta["cfg"])
+    # the same soundness bounds init_state enforces: a peer-declared
+    # narrow-coordinate config past them would carry already-wrapped
+    # seqs that every later predicate silently miscounts
+    if cfg_chk.coord8 and not coord8_ok(cfg_chk.s_cap):
+        raise ValueError(f"snapshot declares unsound coord8 cfg: {cfg_chk}")
+    if cfg_chk.coord16 and not cfg_chk.coord8 \
+            and not coord16_ok(cfg_chk.s_cap):
+        raise ValueError(f"snapshot declares unsound coord16 cfg: {cfg_chk}")
     policy = policy or {}
 
     participants: Dict[str, int] = {k: int(v) for k, v in meta["participants"]}

@@ -46,13 +46,14 @@ def _parity_fields_equal(a, b, e):
 
 
 @pytest.mark.parametrize("fd_mode", ["fast", "full", "incremental"])
-def test_coord16_fused_parity(fd_mode):
+@pytest.mark.parametrize("narrow", [dict(coord16=True), dict(coord8=True)])
+def test_narrow_coord_fused_parity(fd_mode, narrow):
     n, e = 16, 500
     dag = random_gossip_arrays(n, e, seed=21)
     batch = batch_from_arrays(dag)
     base = dict(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=32)
     cfg32 = DagConfig(**base)
-    cfg16 = DagConfig(**base, coord16=True)
+    cfg16 = DagConfig(**base, **narrow)
     assert coord16_ok(cfg16.s_cap)
 
     out32 = jax.jit(functools.partial(consensus_step_impl, cfg32, fd_mode))(

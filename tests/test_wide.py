@@ -42,6 +42,44 @@ def test_wide_pipeline_parity(n, e, r_cap, seed):
     assert int((np.asarray(ref.rr)[:e] >= 0).sum()) > 0
 
 
+@pytest.mark.parametrize("n_blocks", [2, 3])
+def test_wide_pipeline_blocked_parity(n_blocks):
+    """Force multiple column blocks (including a ragged last block) and
+    pin the blocked pipeline to the fused one bit-for-bit."""
+    n, e = 22, 900          # 22 % 3 != 0: ragged last block
+    dag = random_gossip_arrays(n, e, seed=17)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=32)
+    ref = jax.jit(functools.partial(consensus_step_impl, cfg, "fast"))(
+        init_state(cfg), batch
+    )
+    got = run_wide_pipeline(cfg, batch, n_blocks=n_blocks)
+    assert_consensus_parity(ref, got, e, label=f"wide C={n_blocks}")
+    assert int(ref.lcr) >= 0
+
+
+def test_wide_pipeline_coord8_blocked():
+    n, e = 16, 700
+    dag = random_gossip_arrays(n, e, seed=19)
+    batch = batch_from_arrays(dag)
+    base = dict(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=32)
+    ref = jax.jit(
+        functools.partial(consensus_step_impl, DagConfig(**base), "fast")
+    )(init_state(DagConfig(**base)), batch)
+    cfg8 = DagConfig(**base, coord8=True)
+    got = run_wide_pipeline(cfg8, batch, n_blocks=2, assemble=False)
+    import numpy as np
+    for f in ("round", "witness", "rr", "cts"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, f))[:e], np.asarray(getattr(got, f))[:e],
+            err_msg=f,
+        )
+    np.testing.assert_array_equal(np.asarray(ref.famous),
+                                  np.asarray(got.famous))
+    assert int(ref.lcr) == int(got.lcr) >= 0
+    assert got.la is None and got.fd is None   # assemble=False contract
+
+
 def test_wide_wins_dispatch():
     assert not wide_wins(DagConfig(n=1024, e_cap=100_000, s_cap=131,
                                    r_cap=16))
