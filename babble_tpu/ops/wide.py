@@ -377,12 +377,43 @@ def _jits(cfg: DagConfig, C: int):
             out = jnp.where(cols[None, :] < n, out, fill)
         return out
 
-    def _med_tv_block(state, fd_blk_rows, i_rows, seqw, fam, blk_off):
+    def _ts_range(state):
+        valid = state.seq >= 0
+        tmin = jnp.min(jnp.where(valid, state.ts, INT64_MAX))
+        tmax = jnp.max(jnp.where(valid, state.ts, -INT64_MAX - 1))
+        # real-world timestamps are granular (the sim quantizes to 1 us);
+        # dividing by the granularity is what brings a multi-hour span
+        # under 2^31 for the i32 median path
+        div1000 = jnp.all(
+            jnp.where(valid, (state.ts - tmin) % 1000, 0) == 0
+        )
+        return tmin, tmax, div1000
+
+    ts_range = jax.jit(_ts_range)
+
+    def _med_tv_block(state, fd_blk_rows, i_rows, seqw, fam, blk_off,
+                      tmin, scale, rel32):
         """Per-block tv columns for a chunk of events: the timestamp of
-        chain j's event at seq fd[x, j], masked to famous seers."""
+        chain j's event at seq fd[x, j], masked to famous seers.
+
+        ``rel32`` (static): timestamps span < 2^31 ns, so the median
+        machinery runs on i32 offsets from tmin — the S-step
+        select-accumulate and the sort are this phase's HBM-bound bulk
+        (measured 62% of peak bandwidth at 10k x 600k), and halving the
+        element width halves it.  Rows with no seers surface INF and are
+        masked by `newly` downstream (a received event always has
+        seers)."""
         rows_c = jnp.clip(blk_off + jnp.arange(w), 0, n)
         cej = state.ce[rows_c]                               # [w, S+1]
         ts_grid = state.ts[sanitize(cej, e_cap)]             # i64[w, S+1]
+        inf = jnp.asarray(
+            jnp.iinfo(jnp.int32).max if rel32 else INT64_MAX,
+            jnp.int32 if rel32 else state.ts.dtype,
+        )
+        if rel32:
+            # invalid grid cells wrap to garbage, but every cell a `sees`
+            # row selects is a real event (fd <= seqw implies existence)
+            ts_grid = ((ts_grid - tmin) // scale).astype(jnp.int32)
         sw = _col_gather_t(seqw, blk_off)[i_rows]            # [chunk, w]
         fm = _col_gather_t(fam, blk_off, fill=False)[i_rows]
         sees = fm & (fd_blk_rows <= sw)
@@ -394,24 +425,25 @@ def _jits(cfg: DagConfig, C: int):
 
             tv = jax.lax.fori_loop(
                 0, s_cap + 1, acc_step,
-                jnp.full(fdc.shape, INT64_MAX, dtype=state.ts.dtype),
+                jnp.full(fdc.shape, inf, dtype=ts_grid.dtype),
             )
         else:
             tv = ts_grid[jnp.arange(w)[None, :], fdc]
-        return jnp.where(sees, tv, INT64_MAX), sees.sum(
-            axis=1, dtype=I32
-        )
+        return jnp.where(sees, tv, inf), sees.sum(axis=1, dtype=I32)
 
-    med_tv_block = jax.jit(_med_tv_block, static_argnums=())
+    med_tv_block = jax.jit(_med_tv_block, static_argnums=(8,))
 
-    def _med_reduce(tv_full, cnt_s, newly_rows, cts_rows):
+    def _med_reduce(tv_full, cnt_s, newly_rows, cts_rows, tmin, scale,
+                    rel32):
         tv_sorted = jnp.sort(tv_full, axis=1)
         rows = tv_full.shape[0]
         med = tv_sorted[jnp.arange(rows),
                         jnp.clip(cnt_s // 2, 0, n - 1)]
+        if rel32:
+            med = med.astype(jnp.int64) * scale + tmin
         return jnp.where(newly_rows, med, cts_rows)
 
-    med_reduce = jax.jit(_med_reduce)
+    med_reduce = jax.jit(_med_reduce, static_argnums=(6,))
 
     def _slice_rows(a, e0, rows):
         return jax.lax.dynamic_slice_in_dim(a, e0, rows, 0)
@@ -435,6 +467,7 @@ def _jits(cfg: DagConfig, C: int):
         fame_tally=fame_tally, fame_write=fame_write, fame_fin=fame_fin,
         order_prep=order_prep, sees_partial_block=sees_partial_block,
         order_rr_update=order_rr_update, med_tv_block=med_tv_block,
+        ts_range=ts_range,
         med_reduce=med_reduce, slice_rows=slice_rows,
         write_rows=write_rows, med_chunk=med_chunk, width=w,
     )
@@ -520,7 +553,7 @@ def _blocked_ss(j, C, w, la_rows_by_block, fd_rows_by_block, n):
 
 
 def run_wide_rounds(cfg: DagConfig, state: DagState, la_blocks,
-                    fd_blocks, C: int) -> DagState:
+                    fd_blocks, C: int, stats=None) -> DagState:
     """Blocked host-driven frontier march (device twin:
     _rounds_frontier, differentially tested)."""
     _assert_fresh(state)
@@ -565,11 +598,14 @@ def run_wide_rounds(cfg: DagConfig, state: DagState, la_blocks,
         alive = bool(any_next)
         r += 1
 
+    if stats is not None:
+        stats["round_steps"] = r
+        stats["bisect_iters"] = bisect_iters
     return j["frontier_fin"](state, pos_table)
 
 
 def run_wide_fame(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
-                  C: int) -> DagState:
+                  C: int, stats=None) -> DagState:
     """Blocked host-driven fame voting (device twin:
     decide_fame_block_impl, differentially tested)."""
     _assert_fresh(state)
@@ -617,13 +653,22 @@ def run_wide_fame(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
             )
             und_any = bool(und)
             d += 1
+        if stats is not None:
+            # rounds-to-fame latency: the voting distance at which round
+            # i's witnesses were all decided (BASELINE's north-star
+            # metric); max_round+1 marks "ran out of voting rounds"
+            stats.setdefault("fame_decision_distance", {})[i_abs] = (
+                d - 1 if not und_any else None
+            )
+            stats["fame_vote_steps"] = stats.get("fame_vote_steps", 0) \
+                + (d - 2)
         famous = j["fame_write"](famous, famous_i, jnp.asarray(i, I32))
     state = state._replace(famous=famous)
     return state._replace(lcr=j["fame_fin"](state, famous))
 
 
 def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
-                   C: int) -> DagState:
+                   C: int, stats=None) -> DagState:
     """Blocked host-driven round-received + median timestamps (device
     twin: decide_order_impl, differentially tested)."""
     _assert_fresh(state)
@@ -645,6 +690,13 @@ def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
     newly = und & (rr != -1)
     i_of = jnp.clip(rr - state.r_off, 0, cfg.r_cap - 1)
 
+    tmin, tmax, div1000 = j["ts_range"](state)
+    span = int(np.asarray(tmax - tmin))
+    scale = 1000 if (bool(np.asarray(div1000))
+                     and span // 1000 < (1 << 31) - 1
+                     and span >= (1 << 31) - 1) else 1
+    rel32 = span // scale < (1 << 31) - 1
+    scale_j = jnp.asarray(scale, jnp.int64)
     cts = state.cts
     chunk = j["med_chunk"]
     for k, e0 in enumerate(range(0, e1, chunk)):
@@ -656,7 +708,7 @@ def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
             fd_rows = j["slice_rows"](fd_blocks[blk], e0j, chunk)
             tv_b, cnt_b = j["med_tv_block"](
                 state, fd_rows, i_rows, seqw, fam,
-                jnp.asarray(blk * w, I32),
+                jnp.asarray(blk * w, I32), tmin, scale_j, rel32,
             )
             tvs.append(tv_b)
             cnts.append(cnt_b)
@@ -664,10 +716,15 @@ def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
         cnt_s = sum(cnts[1:], cnts[0])
         new_rows = j["slice_rows"](newly, e0j, chunk)
         cts_rows = j["slice_rows"](cts, e0j, chunk)
-        upd = j["med_reduce"](tv_full, cnt_s, new_rows, cts_rows)
+        upd = j["med_reduce"](tv_full, cnt_s, new_rows, cts_rows, tmin,
+                              scale_j, rel32)
         cts = j["write_rows"](cts, e0j, upd)
         if k % 8 == 7:
             _ = np.asarray(cts[:1])      # dispatch backpressure
+    if stats is not None:
+        stats["median_chunks"] = -(-e1 // chunk)
+        stats["median_chunk_rows"] = chunk
+        stats["median_rel32"] = rel32
     return state._replace(rr=rr, cts=cts)
 
 
@@ -679,6 +736,7 @@ def run_wide_pipeline(
     timings: Optional[dict] = None,
     n_blocks: Optional[int] = None,
     assemble: bool = True,
+    stats: Optional[dict] = None,
 ) -> DagState:
     """Full batch pipeline at wide N: coords -> rounds -> fame -> order.
 
@@ -693,6 +751,10 @@ def run_wide_pipeline(
     if fd_mode != "fast":
         raise ValueError("wide pipeline supports the 'fast' batch mode")
     C = n_blocks or block_count(cfg)
+    if stats is not None:
+        stats["n_blocks"] = C
+        stats["onehot_partials"] = _use_onehot_partial(cfg)
+        stats["levels"] = int(batch.sched.shape[0])
 
     def tick(name, t0):
         if timings is not None:
@@ -723,15 +785,15 @@ def run_wide_pipeline(
     _ = np.asarray(la_blocks[0][:1, :1])
     tick("coords", t0)
     t0 = time.perf_counter()
-    state = run_wide_rounds(cfg, state, la_blocks, fd_blocks, C)
+    state = run_wide_rounds(cfg, state, la_blocks, fd_blocks, C, stats)
     _ = np.asarray(state.max_round)
     tick("rounds", t0)
     t0 = time.perf_counter()
-    state = run_wide_fame(cfg, state, la_blocks, fd_blocks, C)
+    state = run_wide_fame(cfg, state, la_blocks, fd_blocks, C, stats)
     _ = np.asarray(state.lcr)
     tick("fame", t0)
     t0 = time.perf_counter()
-    state = run_wide_order(cfg, state, la_blocks, fd_blocks, C)
+    state = run_wide_order(cfg, state, la_blocks, fd_blocks, C, stats)
     _ = np.asarray(state.rr[:1])
     tick("order", t0)
     if assemble:

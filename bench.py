@@ -41,6 +41,85 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# v5e single-chip peaks (public spec): the roofline denominators
+V5E_PEAK_INT8_OPS = 394e12
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_PEAK_HBM_BPS = 819e9
+
+DETAIL: dict = {}   # accumulated per-config detail -> BENCH_DETAIL.json
+
+
+def _roofline(flops, bytes_, seconds, unit="int8_ops"):
+    """Achieved vs peak on both roofline axes; the phase is bound by
+    whichever fraction is higher."""
+    peak = V5E_PEAK_INT8_OPS if unit == "int8_ops" else V5E_PEAK_BF16_FLOPS
+    out = {
+        "flops": flops, "bytes": bytes_, "seconds": round(seconds, 3),
+        "achieved_tops": round(flops / seconds / 1e12, 2) if seconds else 0,
+        "achieved_gbs": round(bytes_ / seconds / 1e9, 1) if seconds else 0,
+        "pct_peak_compute": round(100 * flops / seconds / peak, 2)
+        if seconds else 0,
+        "pct_peak_hbm": round(100 * bytes_ / seconds / V5E_PEAK_HBM_BPS, 2)
+        if seconds else 0,
+    }
+    out["bound"] = ("compute" if out["pct_peak_compute"]
+                    >= out["pct_peak_hbm"] else "hbm")
+    return out
+
+
+def wide_phase_accounting(cfg, stats, timings, sched_shape):
+    """Per-phase FLOP + HBM-byte model of the wide pipeline, from config
+    shapes and the executed step counts (stats).  Counts are the
+    *algorithmic* work of each phase's dominant kernels; achieved-vs-peak
+    says which phases are compute- vs bandwidth-bound and how far from
+    the v5e roofline they run."""
+    import numpy as np
+
+    n, e1, s1 = cfg.n, cfg.e_cap + 1, cfg.s_cap + 1
+    it = np.dtype(cfg.coord_dtype).itemsize
+    T, B = sched_shape
+    C = stats.get("n_blocks", 1)
+
+    # coords: per level per block, gather 2 parent row-sets + write rows
+    coords_bytes = 2 * (4 * T * B * n * it)          # la scan + fd scan
+    coords_flops = 2 * (2 * T * B * n)               # max/min + select
+
+    # one strongly-see [N, N] tally: one-hot MXU matmul over (k, s)
+    ss_flops_onehot = 2 * n * n * (C * -(-n // C)) * s1
+    ss_bytes = 2 * n * n * s1 * 1 + 4 * n * n * 4    # P/Q builds + acc RW
+    onehot = stats.get("onehot_partials", False)
+    ss_flops = ss_flops_onehot if onehot else 2 * n * n * n
+
+    r_iters = stats.get("round_steps", 0) * stats.get("bisect_iters", 0)
+    rounds_flops = r_iters * ss_flops
+    rounds_bytes = r_iters * ss_bytes
+
+    v_steps = stats.get("fame_vote_steps", 0)
+    fame_flops = v_steps * (ss_flops + 2 * n * n * n)   # ss + bf16 tally
+    fame_bytes = v_steps * (ss_bytes + 3 * n * n * 4)
+
+    # order: R streaming passes over fd + per-chunk S-step median
+    chunks = stats.get("median_chunks", 0)
+    crows = stats.get("median_chunk_rows", 0)
+    tw = 4 if stats.get("median_rel32") else 8   # i32 relative-ts path
+    order_bytes = (cfg.r_cap * e1 * n * it
+                   + chunks * s1 * crows * n * 2 * tw  # select-accumulate
+                   + chunks * crows * n * tw * 2)      # sort RW (1 pass amortized lower bound)
+    order_flops = cfg.r_cap * e1 * n + chunks * crows * n * np.log2(max(n, 2))
+
+    unit = "int8_ops" if onehot else "bf16"
+    return {
+        "coords": _roofline(coords_flops, coords_bytes,
+                            timings.get("coords", 0), "bf16"),
+        "rounds": _roofline(rounds_flops, rounds_bytes,
+                            timings.get("rounds", 0), unit),
+        "fame": _roofline(fame_flops, fame_bytes,
+                          timings.get("fame", 0), unit),
+        "order": _roofline(order_flops, order_bytes,
+                           timings.get("order", 0), "bf16"),
+    }
+
+
 def run_config(n, e, s_cap_min, r_cap):
     import jax
     import numpy as np
@@ -114,6 +193,81 @@ def run_config(n, e, s_cap_min, r_cap):
     log(f"[{n}x{e}] times: {[f'{x:.3f}' for x in times]} -> {eps:,.0f} ev/s"
         + (f" = {vs:.2f}x reference" if vs else ""))
     return eps, vs
+
+
+def run_wide(n, e, coord8=False, r_cap=8, repeats=2, tag=None):
+    """Wide-pipeline config with per-phase timings, roofline accounting,
+    and the BASELINE north-star metric: rounds-to-fame latency (the
+    voting distance at which each round's witnesses are all decided).
+
+    At n=10k ordering additionally needs round >= 3 to exist (one round
+    is ~150-200k events at 10k — ordering at that scale is the v5e-8
+    sharded territory BASELINE prescribes); round-0 fame IS decided on
+    one chip, which is what rounds-to-fame measures."""
+    import jax
+    import numpy as np
+
+    from babble_tpu.ops.state import DagConfig
+    from babble_tpu.ops.wide import block_count, run_wide_pipeline
+    from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
+    tag = tag or f"wide {n}x{e}"
+    t0 = time.perf_counter()
+    dag = random_gossip_arrays(n, e, seed=7)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 3, r_cap=r_cap,
+                    coord8=coord8)
+    log(f"[{tag}] host build {time.perf_counter()-t0:.2f}s; "
+        f"levels={dag.n_levels} {cfg} C={block_count(cfg)}")
+
+    best = None
+    for rep in range(repeats):
+        timings, stats = {}, {}
+        t0 = time.perf_counter()
+        st = run_wide_pipeline(cfg, batch, timings=timings, stats=stats,
+                               assemble=False)
+        total = time.perf_counter() - t0
+        rr = np.asarray(st.rr)[:e]
+        ordered = int((rr >= 0).sum())
+        lcr, max_round = int(st.lcr), int(st.max_round)
+        t = {k: round(v, 2) for k, v in timings.items()}
+        log(f"[{tag}] rep{rep}: total {total:.2f}s {t} ordered={ordered} "
+            f"lcr={lcr} max_round={max_round}")
+        if best is None or total < best["total_s"]:
+            best = dict(total_s=total, timings=timings, stats=stats,
+                        ordered=ordered, lcr=lcr, max_round=max_round)
+        del st
+
+    assert best["lcr"] >= 0, f"{tag}: no round's fame decided"
+    rtf = best["stats"].get("fame_decision_distance", {})
+    decided = {r: d for r, d in rtf.items() if d is not None}
+    acct = wide_phase_accounting(cfg, best["stats"], best["timings"],
+                                 tuple(batch.sched.shape))
+    detail = {
+        "config": f"{n}x{e}" + ("_int8" if coord8 else ""),
+        "events": e, "participants": n,
+        "total_s": round(best["total_s"], 2),
+        "phase_s": {k: round(v, 2) for k, v in best["timings"].items()},
+        "ordered": best["ordered"], "lcr": best["lcr"],
+        "max_round": best["max_round"],
+        "events_per_sec_processed": round(e / best["total_s"], 1),
+        # BASELINE metric: rounds-to-fame latency.  Structural = voting
+        # rounds until decision (2 = the theoretical floor); wall = the
+        # fame phase seconds for all decided rounds together.
+        "rounds_to_fame_structural": decided,
+        "rounds_to_fame_wall_s": round(best["timings"].get("fame", 0), 2),
+        "roofline": acct,
+        "stats": {k: v for k, v in best["stats"].items()
+                  if k != "fame_decision_distance"},
+    }
+    log(f"[{tag}] rounds-to-fame (structural, per round): {decided}; "
+        f"fame wall {detail['rounds_to_fame_wall_s']}s")
+    for ph, a in acct.items():
+        log(f"[{tag}] {ph}: {a['seconds']}s, {a['achieved_tops']} Tops "
+            f"({a['pct_peak_compute']}% peak), {a['achieved_gbs']} GB/s "
+            f"({a['pct_peak_hbm']}% peak) -> {a['bound']}-bound")
+    DETAIL[detail["config"]] = detail
+    return detail
 
 
 def run_byzantine(n: int, e: int, r_cap: int) -> float:
@@ -348,6 +502,22 @@ def main() -> None:
         eps, vs = run_config(n, e, s_min, r_cap)
         if is_headline:
             headline = (eps, vs)
+    # rounds-to-fame + roofline accounting at 1k (BASELINE metric);
+    # phase-timed via the wide pipeline on the same DAG
+    rtf_1k = rtf_10k = None
+    try:
+        d = run_wide(1024, 100_000, r_cap=16, repeats=2, tag="rtf 1k")
+        rtf_1k = d["rounds_to_fame_structural"]
+    except Exception as e:
+        log(f"[rtf 1k] FAILED: {e}")
+    # the 10k-participant north-star config (VERDICT r3 item 1): int8
+    # column-blocked coordinates, one chip
+    try:
+        d = run_wide(10_000, 600_000, coord8=True, r_cap=8, repeats=2,
+                     tag="10k")
+        rtf_10k = d["rounds_to_fame_structural"]
+    except Exception as e:
+        log(f"[10k] FAILED: {e}")
     try:
         live = run_live()
         with open("BENCH_LIVE.json", "w") as f:
@@ -363,12 +533,16 @@ def main() -> None:
         run_million()
     except Exception as e:
         log(f"[1M] FAILED: {e}")
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(DETAIL, f, indent=1)
     eps, vs = headline
     print(json.dumps({
         "metric": "consensus_events_per_sec_1024x100k",
         "value": round(eps, 2),
         "unit": "events/s",
         "vs_baseline": round(vs, 2) if vs else None,
+        "rounds_to_fame_1k": rtf_1k,
+        "rounds_to_fame_10k": rtf_10k,
     }))
 
 
