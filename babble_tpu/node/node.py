@@ -76,6 +76,8 @@ class Node:
         # when gossip tasks overlap.
         self._commit_queue: "asyncio.Queue[List[Event]]" = asyncio.Queue()
         self._committer: Optional[asyncio.Task] = None
+        self._consensus_task: Optional[asyncio.Task] = None
+        self._consensus_dirty = False
 
         # stats counters (the reference declares but never increments its
         # sync counters, node.go:64-65; here they are real)
@@ -110,6 +112,12 @@ class Node:
         consumer = self.transport.consumer
         if self._committer is None:
             self._committer = asyncio.create_task(self._commit_loop())
+        if (gossip and self.conf.consensus_interval > 0
+                and self._consensus_task is None):
+            self._consensus_task = asyncio.create_task(
+                self._consensus_loop()
+            )
+            self._tasks.append(self._consensus_task)
         # The heartbeat is a fixed deadline, not an idle timeout: inbound
         # traffic must not postpone outbound gossip (the reference's timer
         # channel keeps ticking across select iterations, node.go:127-133).
@@ -148,7 +156,16 @@ class Node:
                         t = asyncio.create_task(self._gossip(peer.net_addr))
                         self._gossip_tasks.add(t)
                         t.add_done_callback(self._gossip_tasks.discard)
-                deadline = _time.monotonic() + self._random_timeout()
+                # ABSOLUTE pacing: advance from the previous deadline, not
+                # from now — rebasing to monotonic() leaks the loop's
+                # servicing time into every cycle (~3% of the heartbeat in
+                # the 10 ms fleet, measured as 250 vs 265 ev/s against the
+                # reference testnet).  After a long stall, re-anchor
+                # instead of bursting to catch up.
+                deadline += self._random_timeout()
+                now = _time.monotonic()
+                if deadline < now:
+                    deadline = now + 0.2 * self._random_timeout()
 
     def run_task(self, gossip: bool = True) -> asyncio.Task:
         """RunAsync (reference node.go:114-117)."""
@@ -382,41 +399,69 @@ class Node:
                 self.transaction_pool = payload + self.transaction_pool
                 raise
             t1 = time.perf_counter()
-            # Consensus cadence (Config.consensus_interval): when gossip is
-            # faster than a device pipeline call, skip consensus here and
-            # let the next due sync batch everything inserted since — same
-            # total order, fewer/larger kernel launches, and the core lock
-            # stays available to serve peers.
-            interval = self.conf.consensus_interval
-            due = (
-                interval <= 0.0
-                or time.monotonic() - self._last_consensus >= interval
-            )
-            if not due:
+            # Consensus cadence (Config.consensus_interval > 0): the
+            # pipeline runs in its own task (_consensus_loop), OFF the
+            # gossip critical path — an 8-17 ms device pipeline call in
+            # the middle of a sync response stalls both this node's next
+            # heartbeat and every peer waiting on our diff (measured as
+            # the consensus_ms outliers behind the r2 250-vs-265 ev/s
+            # fleet gap).  interval <= 0 keeps the reference's
+            # consensus-after-every-sync shape (node.go:224).
+            if self.conf.consensus_interval > 0:
                 self.timings = {**self.timings, "sync_ms": (t1 - t0) * 1e3}
+                self._consensus_dirty = True
                 return
-            self._last_consensus = time.monotonic()
-            new_events, phase_timings = await loop.run_in_executor(
-                None, self.core.run_consensus
-            )
-            t2 = time.perf_counter()
-            self.timings = {
-                "sync_ms": (t1 - t0) * 1e3,
-                "consensus_ms": (t2 - t1) * 1e3,
-                **{
-                    k.replace("_s", "_ms"): v * 1e3
-                    for k, v in phase_timings.items()
-                },
-            }
-            self.logger.debug(
-                "sync %d events in %.1fms, consensus %.1fms",
-                len(resp.events), self.timings["sync_ms"],
-                self.timings["consensus_ms"],
-            )
-            if new_events:
-                # enqueue under the lock: batches reach the committer in
-                # consensus order even when gossip tasks overlap
-                self._commit_queue.put_nowait(new_events)
+            await self._run_consensus_locked(t0, t1, len(resp.events))
+
+    async def _run_consensus_locked(self, t0, t1, n_events) -> None:
+        """Run the consensus pipeline; caller holds the core lock."""
+        loop = asyncio.get_running_loop()
+        self._last_consensus = time.monotonic()
+        new_events, phase_timings = await loop.run_in_executor(
+            None, self.core.run_consensus
+        )
+        t2 = time.perf_counter()
+        sync_ms = (
+            (t1 - t0) * 1e3 if t1 > t0
+            else self.timings.get("sync_ms", 0.0)  # cadence path: keep last real sync
+        )
+        self.timings = {
+            "sync_ms": sync_ms,
+            "consensus_ms": (t2 - t1) * 1e3,
+            **{
+                k.replace("_s", "_ms"): v * 1e3
+                for k, v in phase_timings.items()
+            },
+        }
+        self.logger.debug(
+            "sync %d events in %.1fms, consensus %.1fms",
+            n_events, self.timings["sync_ms"],
+            self.timings["consensus_ms"],
+        )
+        if new_events:
+            # enqueue under the lock: batches reach the committer in
+            # consensus order even when gossip tasks overlap
+            self._commit_queue.put_nowait(new_events)
+
+    async def _consensus_loop(self) -> None:
+        """Dedicated consensus cadence (Config.consensus_interval > 0):
+        one pipeline call per interval, batching every sync inserted
+        since — same total order, fewer/larger kernel launches, and the
+        only gossip cost is the lock hold of the call itself."""
+        interval = self.conf.consensus_interval
+        while not self._shutdown.is_set():
+            await asyncio.sleep(interval)
+            if not self._consensus_dirty:
+                continue      # nothing inserted since the last run
+            self._consensus_dirty = False
+            try:
+                async with self.core_lock:
+                    t = time.perf_counter()
+                    await self._run_consensus_locked(t, t, 0)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.logger.warning("consensus loop failed: %s", e)
 
     async def _commit_loop(self) -> None:
         """Deliver consensus transactions to the app, strictly in batch

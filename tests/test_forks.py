@@ -154,3 +154,52 @@ def test_fd_reverse_matches_chain_counts():
     a = np.asarray(jax.jit(lambda b: F._fd_reverse(cfg, b))(batch))
     c = np.asarray(jax.jit(lambda b: F._fd_chains(cfg, b, la))(batch))
     assert (a == c).all(), f"{int((a != c).sum())} fd mismatches"
+
+
+@pytest.mark.parametrize("seed,tight", [(3, False), (9, True), (21, True)])
+def test_rounds_closure_matches_level_scan(seed, tight):
+    """_rounds_closure (the per-round closure iteration that replaced the
+    level scan for speed) must agree with _rounds_scan bit-for-bit —
+    including at TIGHT r_cap = max_round + 1, the capacity where an
+    off-by-one in the closure's loop bound silently dropped the top
+    round (caught in review; this test is the regression anchor)."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from babble_tpu.ops import forks as F
+
+    dag = random_byzantine_dag(9, 400, seed=seed, fork_rate=0.06)
+    fh = ForkHashgraph(dag.participants, k=2)
+    for ev in dag.events:
+        fh.insert_event(ev.clone())
+    cfg, _ = fh._run()
+
+    def run(cfg):
+        batch = fh.dag.build_batch(cfg)
+        la = jax.jit(lambda b: F._la_scan(cfg, b))(batch)
+        det = jax.jit(lambda b, l: F._detect(cfg, b, l))(batch, la)
+        fdet = jax.jit(lambda b, d: F._first_det(cfg, b, d))(batch, det)
+        fd = jax.jit(lambda b: F._fd_reverse(cfg, b))(batch)
+        helper = jax.jit(lambda b, f, fr: F._helper(cfg, b, f, fr))(
+            batch, fd, fdet
+        )
+        scan = jax.jit(functools.partial(F._rounds_scan, cfg))(
+            batch, la, det, helper
+        )
+        clos = jax.jit(functools.partial(F._rounds_closure, cfg))(
+            batch, la, det, helper
+        )
+        return scan, clos
+
+    scan, clos = run(cfg)
+    if tight:
+        cfg = cfg._replace(r_cap=int(scan[3]) + 1)
+        scan, clos = run(cfg)
+    for name, a, b in zip(("round", "witness", "wslot", "max_round"),
+                          scan, clos):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=name
+        )
+    assert int(scan[3]) >= 1
