@@ -122,3 +122,80 @@ def make_sharded_step(cfg: DagConfig, mesh: Mesh, fd_mode: str = "full"):
 
 def sharded_init_state(cfg: DagConfig, mesh: Mesh) -> DagState:
     return place_state(init_state(cfg), mesh)
+
+
+# ----------------------------------------------------------------------
+# byzantine (fork) pipeline sharding: the branch-column axis B = n*k is
+# the wide dimension; partition it over "p" exactly like the honest N
+# axis.  The creator-grouped reductions (strided OR over the k branch
+# slots) contract B -> N, so "p" must divide n (then it divides B=k*n);
+# strongly-see counts then run as per-shard partials + psum, inserted by
+# XLA from the sharding constraints.
+
+
+def fork_batch_specs():
+    from ..ops.forks import ForkBatch
+
+    ev = P("ev")
+    return ForkBatch(
+        sp=ev, op=ev, ebr=ev, eseq=ev, ecr=ev, ts=ev, mbit=ev,
+        sched=P(), cp=P("p", None), ce=P("p", None), cnt=P("p"),
+        owner=P("p", None), n_events=P(),
+    )
+
+
+def fork_out_specs():
+    from ..ops.forks import ForkOut
+
+    ev = P("ev")
+    return ForkOut(
+        la=P("ev", "p"), det=P("ev", None), fd=P("ev", "p"),
+        round=ev, witness=ev, wslot=P(None, "p"), famous=P(None, "p"),
+        rr=ev, cts=ev, max_round=P(), lcr=P(),
+    )
+
+
+def pad_fork_for_mesh(cfg, batch, mesh: Mesh):
+    """Round the fork batch's event axis up so e_cap+1 divides the "ev"
+    mesh axis.  Padding rows replicate the sentinel (sp=-1, eseq=-1 ...),
+    so they are invisible; the old sentinel row just becomes one more
+    dead event row."""
+    from ..ops.forks import ForkBatch
+
+    ev = mesh.shape["ev"]
+    e1_new = _ceil_to(cfg.e_cap + 1, ev)
+    if e1_new == cfg.e_cap + 1:
+        return cfg, batch
+    pad = e1_new - (cfg.e_cap + 1)
+
+    def pad1(a, fill):
+        return jnp.concatenate(
+            [a, jnp.full((pad,), fill, a.dtype)]
+        )
+
+    batch = batch._replace(
+        sp=pad1(batch.sp, -1), op=pad1(batch.op, -1),
+        ebr=pad1(batch.ebr, cfg.b), eseq=pad1(batch.eseq, -1),
+        ecr=pad1(batch.ecr, cfg.n), ts=pad1(batch.ts, 0),
+        mbit=pad1(batch.mbit, False),
+    )
+    return cfg._replace(e_cap=e1_new - 1), batch
+
+
+def make_sharded_fork_step(cfg, mesh: Mesh):
+    """Jit the whole fork pipeline with mesh shardings annotated."""
+    from ..ops.forks import fork_pipeline_impl
+
+    if cfg.n % mesh.shape["p"]:
+        raise ValueError(
+            f"mesh 'p'={mesh.shape['p']} must divide creators n={cfg.n}"
+        )
+    to_shard = lambda tree: jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.jit(
+        functools.partial(fork_pipeline_impl, cfg),
+        in_shardings=(to_shard(fork_batch_specs()),),
+        out_shardings=to_shard(fork_out_specs()),
+    )

@@ -124,3 +124,73 @@ def test_multihost_hybrid_mesh_parity():
         functools.partial(consensus_step_impl, pcfg, "full")
     )(init_state(pcfg), batch)
     assert_consensus_parity(ref, out, e, "multihost-hybrid")
+
+
+def test_sharded_fork_pipeline_parity():
+    """The byzantine fork pipeline partitioned over the ('ev','p') mesh
+    (branch columns p-sharded) must match the single-device run
+    bit-for-bit on every consensus-observable tensor (VERDICT r2 weak
+    #4: the fork kernels' branch axis had never been partitioned)."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from babble_tpu.ops.forks import fork_pipeline_impl
+    from babble_tpu.parallel import make_mesh
+    from babble_tpu.parallel.sharded import (
+        make_sharded_fork_step, pad_fork_for_mesh,
+    )
+    from babble_tpu.sim.arrays import random_byzantine_fork_batch
+
+    cfg, batch = random_byzantine_fork_batch(
+        12, 600, seed=13, fork_rate=0.08, r_cap=16
+    )
+    mesh = make_mesh(8)         # ev x p; p=2 divides n=12
+    cfg, batch = pad_fork_for_mesh(cfg, batch, mesh)
+    step = make_sharded_fork_step(cfg, mesh)
+    sharded = step(batch)
+    ref = jax.jit(functools.partial(fork_pipeline_impl, cfg))(batch)
+    for name in ("la", "det", "fd", "round", "witness", "wslot",
+                 "famous", "rr", "cts"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref, name)),
+            np.asarray(getattr(sharded, name)), err_msg=name,
+        )
+    assert int(ref.lcr) == int(sharded.lcr) >= 0
+    assert int(np.asarray(ref.det).sum()) > 0   # forks actually detected
+
+
+def test_sharded_honest_parity_larger_shape():
+    """Non-toy sharded honest parity: hundreds of participants, tens of
+    thousands of events on the 8-device mesh (VERDICT r2 weak #4: every
+    earlier sharded parity case used n<=8, e<=255)."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from babble_tpu.ops.state import (
+        DagConfig, assert_consensus_parity, init_state,
+    )
+    from babble_tpu.parallel import (
+        make_mesh, make_sharded_step, pad_cfg_for_mesh, sharded_init_state,
+    )
+    from babble_tpu.parallel.sharded import consensus_step_impl
+    from babble_tpu.sim.arrays import batch_from_arrays, random_gossip_arrays
+
+    n, e = 256, 20_000
+    dag = random_gossip_arrays(n, e, seed=31)
+    batch = batch_from_arrays(dag)
+    cfg = DagConfig(n=n, e_cap=e, s_cap=dag.max_chain + 2, r_cap=16)
+    mesh = make_mesh(8)
+    cfg = pad_cfg_for_mesh(cfg, mesh)
+    step = make_sharded_step(cfg, mesh, "fast")
+    sharded = step(sharded_init_state(cfg, mesh), batch)
+    ref = jax.jit(functools.partial(consensus_step_impl, cfg, "fast"))(
+        init_state(cfg), batch
+    )
+    assert_consensus_parity(ref, sharded, int(ref.n_events),
+                            label="sharded 256x20k")
+    assert int(ref.lcr) >= 1
+    assert int((np.asarray(ref.rr)[:e] >= 0).sum()) > 1000
