@@ -79,6 +79,8 @@ async def _run_node(args) -> int:
         cache_size=args.cache_size,
         consensus_interval=args.consensus_interval / 1000.0,
         seq_window=args.seq_window or None,
+        byzantine=args.byzantine,
+        fork_k=args.fork_k,
     )
     conf.logger.setLevel(args.log_level.upper())
 
@@ -130,6 +132,11 @@ async def _checkpoint_loop(node, ckpt_dir: str, interval: float) -> None:
 
 
 def cmd_run(args) -> int:
+    if getattr(args, "byzantine", False) and args.checkpoint_dir:
+        raise SystemExit(
+            "--byzantine has no checkpoint path; drop --checkpoint_dir "
+            "(README: Byzantine mode scope)"
+        )
     try:
         return asyncio.run(_run_node(args))
     except KeyboardInterrupt:
@@ -356,6 +363,11 @@ def main(argv=None) -> int:
     rn.add_argument("--cache_size", type=int, default=500)
     rn.add_argument("--consensus_interval", type=int, default=0,
                     help="ms between consensus pipeline runs (0 = every sync)")
+    rn.add_argument("--byzantine", action="store_true",
+                    help="fork-aware live mode: accept + detect "
+                         "equivocations instead of rejecting them")
+    rn.add_argument("--fork_k", type=int, default=2,
+                    help="branch slots per creator (fork budget K-1)")
     rn.add_argument("--seq_window", type=int, default=0,
                     help="per-creator rolling window (0 = cache_size)")
     rn.add_argument("--jax_cache", default="",

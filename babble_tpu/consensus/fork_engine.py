@@ -8,9 +8,14 @@ engine on fork-free DAGs.
 
 Execution model is whole-DAG batch: each run_consensus() call re-runs the
 pipeline over everything inserted so far from a fresh device state.  That
-matches the byzantine bench shape (BASELINE "1024-node, 1/3 forks") and
-keeps this engine simple; a fork-aware incremental/live path would reuse
-the same kernels against a persistent state.
+matches the byzantine bench shape (BASELINE "1024-node, 1/3 forks").
+
+Live scope: the engine now exposes the full Core surface (known/diff/
+full-event wire form/commit counters), so a node can run byzantine mode
+end to end (Config.byzantine); the per-consensus cost is whole-window
+batch, amortized by the node's consensus cadence, and memory is bounded
+only by the run's history — the honest engine's rolling-window eviction
+does not yet apply here (see README "Byzantine mode" scope note).
 """
 
 from __future__ import annotations
@@ -19,7 +24,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..core.event import Event
+from ..core.event import Event, FullWireEvent
 from ..ops.forks import (
     FAME_TRUE,
     FAME_UNDEFINED,
@@ -37,24 +42,98 @@ class ForkHashgraph:
         participants: Dict[str, int],
         k: int = 2,
         commit_callback=None,
+        verify_signatures: bool = False,
     ):
         self.participants = participants
         self.k = k
         self.dag = ForkDag(participants, k=k)
         self.commit_callback = commit_callback
+        self.verify_signatures = verify_signatures
         self.consensus: List[str] = []
         self.consensus_transactions = 0
+        self.last_committed_round_events = 0
         self._received: set = set()
         self._out = None
         self._dirty = True
+        self._lcr_cache = -1    # host mirror: /Stats must never touch device
 
     @property
     def n(self) -> int:
         return len(self.participants)
 
     def insert_event(self, event: Event) -> None:
+        if self.verify_signatures:
+            if event.creator not in self.participants:
+                raise ValueError("creator is not a participant")
+            if not event.verify():
+                raise ValueError("bad event signature")
         self.dag.insert(event)
         self._dirty = True
+
+    # ------------------------------------------------------------------
+    # Core surface (gossip protocol; mirrors TpuHashgraph's)
+
+    def known(self) -> Dict[int, int]:
+        """Per-CREATOR event counts.  Under equivocation this vector
+        clock is approximate (two nodes can hold equally-sized but
+        different event sets for a byzantine creator); repeated random
+        gossip converges the sets, and the commit surface only ever
+        orders fully-propagated events.  Exact reconciliation would need
+        set digests — out of scope, like everywhere else (the reference
+        refuses forked streams outright)."""
+        return {
+            cid: len(self.dag.cr_events[cid])
+            for cid in self.participants.values()
+        }
+
+    def participant_events(self, pub: str, skip: int) -> List[str]:
+        cid = self.participants[pub]
+        return [
+            self.dag.events[s].hex()
+            for s in self.dag.cr_events[cid][skip:]
+        ]
+
+    def to_wire(self, event: Event) -> FullWireEvent:
+        # the compact (creatorID, index) form is ambiguous under forks
+        return FullWireEvent.from_event(event)
+
+    def read_wire_info(self, w: FullWireEvent) -> Event:
+        return w.to_event()
+
+    # ------------------------------------------------------------------
+    # consensus pipeline surface (Core.run_consensus calls these)
+
+    def divide_rounds(self) -> None:
+        pass          # lazy: _run() computes everything at find_order
+
+    def decide_fame(self) -> None:
+        pass
+
+    def find_order(self) -> List[Event]:
+        return self.run_consensus()
+
+    @property
+    def undetermined_count(self) -> int:
+        return len(self.dag.events) - len(self._received)
+
+    @property
+    def last_consensus_round(self) -> Optional[int]:
+        lcr = self.lcr
+        return None if lcr < 0 else lcr
+
+    def consensus_events_count(self) -> int:
+        return len(self.consensus)
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        return {
+            "last_consensus_round": self._lcr_cache,
+            "undetermined_events": self.undetermined_count,
+            "consensus_events": len(self.consensus),
+            "consensus_transactions": self.consensus_transactions,
+            "last_committed_round_events": self.last_committed_round_events,
+            "evicted_events": 0,      # no rolling window in batch mode
+            "live_window": len(self.dag.events),
+        }
 
     # ------------------------------------------------------------------
 
@@ -77,6 +156,7 @@ class ForkHashgraph:
         batch = self.dag.build_batch(cfg)
         self._out = (cfg, fork_pipeline(cfg, batch))
         self._dirty = False
+        self._lcr_cache = int(np.asarray(self._out[1].lcr))
         return self._out
 
     # ------------------------------------------------------------------
@@ -165,6 +245,12 @@ class ForkHashgraph:
         for ev in new_events:
             self.consensus.append(ev.hex())
             self.consensus_transactions += len(ev.transactions)
+        lcr = int(np.asarray(out.lcr))
+        if lcr >= 1:
+            rnd = np.asarray(out.round)[:ne]
+            self.last_committed_round_events = int(
+                np.count_nonzero(rnd == lcr - 1)
+            )
         if self.commit_callback is not None:
             self.commit_callback(new_events)
         return new_events

@@ -206,6 +206,73 @@ class WireEvent:
         )
 
 
+@dataclass
+class FullWireEvent:
+    """Self-contained wire form: parents as HASHES, creator as pubkey.
+
+    The compact WireEvent resolves parents by (creatorID, index) — a pair
+    an equivocator makes ambiguous (two branch events share an index), so
+    byzantine-mode gossip ships this form instead (~70 bytes more per
+    event).  Distinguished from WireEvent on the wire by list length
+    (8 vs 9)."""
+
+    transactions: List[bytes]
+    self_parent: str
+    other_parent: str
+    creator: bytes
+    timestamp: int
+    index: int
+    r: int
+    s: int
+
+    def pack(self) -> list:
+        return [
+            list(self.transactions),
+            self.self_parent,
+            self.other_parent,
+            self.creator,
+            self.timestamp,
+            self.index,
+            _int_to_b32(self.r),
+            _int_to_b32(self.s),
+        ]
+
+    @classmethod
+    def unpack(cls, obj: list) -> "FullWireEvent":
+        (txs, sp, op, creator, ts, idx, r, s) = obj
+        return cls(
+            transactions=[bytes(t) for t in txs],
+            self_parent=sp, other_parent=op, creator=bytes(creator),
+            timestamp=ts, index=idx,
+            r=int.from_bytes(r, "big"), s=int.from_bytes(s, "big"),
+        )
+
+    @classmethod
+    def from_event(cls, ev: Event) -> "FullWireEvent":
+        return cls(
+            transactions=list(ev.body.transactions),
+            self_parent=ev.body.self_parent,
+            other_parent=ev.body.other_parent,
+            creator=ev.body.creator,
+            timestamp=ev.body.timestamp,
+            index=ev.body.index,
+            r=ev.r, s=ev.s,
+        )
+
+    def to_event(self) -> Event:
+        return Event(
+            body=EventBody(
+                transactions=list(self.transactions),
+                self_parent=self.self_parent,
+                other_parent=self.other_parent,
+                creator=self.creator,
+                timestamp=self.timestamp,
+                index=self.index,
+            ),
+            r=self.r, s=self.s,
+        )
+
+
 def new_event(
     transactions: List[bytes],
     parents: Tuple[str, str],

@@ -12,7 +12,7 @@ from typing import Dict, List
 
 import msgpack
 
-from ..core.event import WireEvent
+from ..core.event import FullWireEvent, WireEvent
 
 RPC_SYNC = 0
 
@@ -48,10 +48,15 @@ class SyncResponse:
     @classmethod
     def unpack(cls, data: bytes) -> "SyncResponse":
         from_addr, head, events = msgpack.unpackb(data, raw=False)
+        # 9 fields = compact WireEvent; 8 = byzantine-mode FullWireEvent
         return cls(
             from_addr=from_addr,
             head=head,
-            events=[WireEvent.unpack(e) for e in events],
+            events=[
+                WireEvent.unpack(e) if len(e) == 9
+                else FullWireEvent.unpack(e)
+                for e in events
+            ],
         )
 
 

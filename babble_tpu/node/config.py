@@ -41,6 +41,10 @@ class Config:
     # smaller to keep the device window (and therefore the jit shapes)
     # fixed under sustained load — eviction then holds e_cap flat forever.
     seq_window: int | None = None
+    # Fork-aware live mode: accept + detect equivocations instead of
+    # rejecting them (the reference's only answer, hashgraph.go:366-396).
+    byzantine: bool = False
+    fork_k: int = 2      # branch slots per creator (fork budget K-1)
     logger: logging.Logger = field(default_factory=_default_logger)
 
     @classmethod

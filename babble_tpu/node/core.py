@@ -28,30 +28,62 @@ class Core:
         e_cap: int = 4096,
         cache_size: Optional[int] = None,
         seq_window: Optional[int] = None,
+        byzantine: bool = False,
+        fork_k: int = 2,
     ):
         self.id = core_id
         self.key = key
         self.pub_hex = key.pub_hex
         self.participants = participants
-        # The live path runs with rolling windows on (auto_compact): memory
-        # stays bounded and peers that fall behind the cache_size window get
-        # TooLateError through the sync path, like the reference's rolling
-        # caches (caches.go:45-76).
-        self.hg = engine or TpuHashgraph(
-            participants, commit_callback=commit_callback, e_cap=e_cap,
-            auto_compact=bool(cache_size),   # 0/None = unbounded history
-            seq_window=seq_window or cache_size or 256,
-            consensus_window=2 * cache_size if cache_size else None,
-        )
+        if engine is not None:
+            # an injected engine is authoritative: the mode flag must
+            # match its type, or diff()/head restore would misbehave
+            from ..consensus.fork_engine import ForkHashgraph
+
+            self.hg = engine
+            byzantine = isinstance(engine, ForkHashgraph)
+        elif byzantine:
+            # fork-aware live mode: equivocations are accepted, detected
+            # and discounted instead of rejected (ops/forks.py); gossip
+            # ships the self-contained FullWireEvent form because the
+            # compact (creatorID, index) references are ambiguous under
+            # forks.  Batch execution per consensus tick — see the README
+            # scope note for the window/memory contract.
+            from ..consensus.fork_engine import ForkHashgraph
+
+            self.hg = ForkHashgraph(
+                participants, k=fork_k,
+                commit_callback=commit_callback,
+                verify_signatures=True,
+            )
+        else:
+            # The live path runs with rolling windows on (auto_compact):
+            # memory stays bounded and peers that fall behind the
+            # cache_size window get TooLateError through the sync path,
+            # like the reference's rolling caches (caches.go:45-76).
+            self.hg = TpuHashgraph(
+                participants, commit_callback=commit_callback, e_cap=e_cap,
+                auto_compact=bool(cache_size),   # 0/None = unbounded history
+                seq_window=seq_window or cache_size or 256,
+                consensus_window=2 * cache_size if cache_size else None,
+            )
+        self.byzantine = byzantine
         self.head: str = ""
         self.seq: int = -1
         # A resumed engine (store.load_checkpoint) already holds our chain —
         # pick up where the checkpoint left off.
-        chain = self.hg.dag.chains[participants[self.pub_hex]]
-        if chain:
-            head_ev = self.hg.dag.events[chain[-1]]
-            self.head = head_ev.hex()
-            self.seq = head_ev.index
+        if byzantine:
+            own = self.hg.dag.cr_events[participants[self.pub_hex]]
+            if own:
+                head_ev = self.hg.dag.events[own[-1]]
+                self.head = head_ev.hex()
+                self.seq = head_ev.index
+        else:
+            chain = self.hg.dag.chains[participants[self.pub_hex]]
+            if chain:
+                head_ev = self.hg.dag.events[chain[-1]]
+                self.head = head_ev.hex()
+                self.seq = head_ev.index
 
     # ------------------------------------------------------------------
 
@@ -150,9 +182,10 @@ class Core:
         """Events we know that the peer doesn't, topologically sorted
         (reference core.go:108-132)."""
         out: List[Event] = []
+        src = self.hg if self.byzantine else self.hg.dag
         for pub, cid in self.participants.items():
             skip = known.get(cid, 0)
-            for hex_id in self.hg.dag.participant_events(pub, skip):
+            for hex_id in src.participant_events(pub, skip):
                 out.append(self.hg.dag.events[self.hg.dag.slot_of[hex_id]])
         out.sort(key=lambda e: e.topological_index)
         return out

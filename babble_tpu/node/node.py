@@ -62,6 +62,8 @@ class Node:
             e_cap=max(conf.cache_size, 64),
             cache_size=conf.cache_size,
             seq_window=conf.seq_window,
+            byzantine=conf.byzantine,
+            fork_k=conf.fork_k,
         )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
@@ -100,6 +102,11 @@ class Node:
     async def save_checkpoint(self, path: str) -> None:
         """Snapshot consensus state under the core lock (see store.checkpoint
         — persistence the reference's Store seam never implemented)."""
+        if self.core.byzantine:
+            raise NotImplementedError(
+                "byzantine mode has no checkpoint path (batch execution; "
+                "see the README scope note)"
+            )
         from ..store import save_checkpoint
 
         async with self.core_lock:
@@ -227,6 +234,10 @@ class Node:
         behind the reference's rolling caches can never rejoin)."""
         from ..store.checkpoint import snapshot_bytes
 
+        if self.core.byzantine:
+            raise NotImplementedError(
+                "byzantine mode cannot serve fast-forward snapshots"
+            )
         loop = asyncio.get_running_loop()
         async with self.core_lock:
             snap = await loop.run_in_executor(

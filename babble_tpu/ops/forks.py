@@ -141,6 +141,8 @@ class ForkDag:
     br_used: List[bool] = field(init=False)
     # (branch col, index) -> slot, for fork-child attachment
     _chain_tip: Dict[int, int] = field(default_factory=dict)   # col -> tip slot
+    # per-CREATOR slots in insertion order (the gossip Known/diff view)
+    cr_events: List[List[int]] = field(init=False)
 
     def __post_init__(self):
         n = len(self.participants)
@@ -150,6 +152,7 @@ class ForkDag:
         self.br_div = [0] * b
         self.br_events = [[] for _ in range(b)]
         self.br_used = [False] * b
+        self.cr_events = [[] for _ in range(n)]
 
     @property
     def n(self) -> int:
@@ -204,6 +207,8 @@ class ForkDag:
                 self.br_div[col] = event.index
         self.events.append(event)
         self.slot_of[x] = slot
+        event.topological_index = slot
+        self.cr_events[cid].append(slot)
         self.sp_slot.append(sps)
         self.op_slot.append(ops)
         self.ebr.append(col)

@@ -29,7 +29,7 @@ import numpy as np
 
 from ..common import OffsetList
 from ..consensus.engine import TpuHashgraph
-from ..core.event import Event, EventBody
+from ..core.event import Event
 from ..ops.state import DagConfig, DagState
 
 FORMAT_VERSION = 3
@@ -40,28 +40,17 @@ _DEVICE = "device.npz"
 
 def _pack_event(ev: Event) -> list:
     """Full self-contained encoding (parent *hashes*, unlike the compact
-    wire form) — restore must not need evicted parent objects."""
-    return [
-        list(ev.body.transactions),
-        ev.body.self_parent,
-        ev.body.other_parent,
-        ev.body.creator,
-        ev.body.timestamp,
-        ev.body.index,
-        ev.r.to_bytes(32, "big"),   # 256-bit ECDSA ints exceed msgpack int64
-        ev.s.to_bytes(32, "big"),
-    ]
+    wire form) — restore must not need evicted parent objects.  The byte
+    format IS FullWireEvent's (one encoding to evolve, not two)."""
+    from ..core.event import FullWireEvent
+
+    return FullWireEvent.from_event(ev).pack()
 
 
 def _unpack_event(obj: list) -> Event:
-    txs, sp, op, creator, ts, idx, r, s = obj
-    return Event(
-        body=EventBody(
-            transactions=list(txs), self_parent=sp, other_parent=op,
-            creator=creator, timestamp=ts, index=idx,
-        ),
-        r=int.from_bytes(r, "big"), s=int.from_bytes(s, "big"),
-    )
+    from ..core.event import FullWireEvent
+
+    return FullWireEvent.unpack(obj).to_event()
 
 
 def _build_meta(engine: TpuHashgraph) -> dict:

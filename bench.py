@@ -358,7 +358,8 @@ def run_million(n: int = 256, e: int = 1_000_000) -> float:
     t = sorted(times)[len(times) // 2]
     eps = ordered / t
     log(f"[1M {n}x{e}] times: {[f'{x:.2f}' for x in times]} -> "
-        f"{eps:,.0f} ev/s ({t:.1f}s to full order)")
+        f"{eps:,.0f} ev/s ({t:.1f}s; {100*ordered/e:.1f}% ordered — the "
+        "remaining tail is legitimately undecidable at the DAG edge)")
     return eps
 
 

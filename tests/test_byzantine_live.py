@@ -1,0 +1,124 @@
+"""Byzantine live mode: a 4-node fleet where one participant
+equivocates.  Honest nodes accept both branches, detect the fork, and
+commit identical consensus prefixes (VERDICT r2 missing #2: the fork
+pipeline wired behind Core/Node as a live mode)."""
+
+import asyncio
+
+import pytest
+
+from babble_tpu.consensus.fork_engine import ForkHashgraph
+from babble_tpu.core.event import FullWireEvent, new_event
+from babble_tpu.crypto.keys import KeyPair, generate_key
+from babble_tpu.net.commands import SyncResponse
+from babble_tpu.node.config import Config
+from babble_tpu.node.core import Core
+
+
+def _mk_cores(n=4):
+    keys = [generate_key() for _ in range(n)]
+    participants = {
+        k.pub_hex: i
+        for i, k in enumerate(sorted(keys, key=lambda k: k.pub_hex))
+    }
+    keys = sorted(keys, key=lambda k: k.pub_hex)
+    cores = [
+        Core(i, keys[i], participants, byzantine=True)
+        for i in range(n)
+    ]
+    for c in cores:
+        c.init()
+    return keys, participants, cores
+
+
+def _sync(a: Core, b: Core):
+    """b pulls from a, then creates its merge head (the gossip exchange)."""
+    diff = a.diff(b.known())
+    wire = a.to_wire(diff)
+    assert all(isinstance(w, FullWireEvent) for w in wire)
+    b.sync(a.head, wire, [])
+
+
+def test_fullwire_roundtrip_survives_msgpack():
+    keys, participants, cores = _mk_cores(2)
+    _sync(cores[0], cores[1])
+    diff = cores[1].diff(cores[0].known())
+    resp = SyncResponse(from_addr="x", head=cores[1].head,
+                       events=cores[1].to_wire(diff))
+    import msgpack
+
+    back = SyncResponse.unpack(msgpack.packb(
+        [resp.from_addr, resp.head, [e.pack() for e in resp.events]],
+        use_bin_type=True,
+    ))
+    assert all(isinstance(w, FullWireEvent) for w in back.events)
+    evs = [cores[0].hg.read_wire_info(w) for w in back.events]
+    assert [e.hex() for e in evs] == [e.hex() for e in diff]
+    for e in evs:
+        assert e.verify()
+
+
+def test_live_equivocator_agreement():
+    keys, participants, cores = _mk_cores(4)
+    byz_id = 3
+    byz_key = keys[byz_id]
+
+    # honest warm-up gossip so everyone has everyone's roots
+    for a in range(4):
+        for b in range(4):
+            if a != b:
+                _sync(cores[a], cores[b])
+
+    # the equivocator forges a SECOND index-1 event (its core already
+    # made honest heads during warm-up; we fork off its root) and plants
+    # one branch at node 0, the other at node 1
+    byz_core = cores[byz_id]
+    root_hex = byz_core.hg.dag.events[
+        byz_core.hg.dag.cr_events[participants[byz_key.pub_hex]][0]
+    ].hex()
+    fork_a = new_event([b"branch-a"], (root_hex, cores[0].head),
+                       byz_key.pub_bytes, 1)
+    fork_a.sign(byz_key)
+    fork_b = new_event([b"branch-b"], (root_hex, cores[1].head),
+                       byz_key.pub_bytes, 1)
+    fork_b.sign(byz_key)
+    cores[0].insert_event(fork_a)
+    cores[1].insert_event(fork_b)
+
+    # rounds of random-ish gossip propagate both branches everywhere
+    import random
+
+    rng = random.Random(7)
+    for _ in range(120):
+        a, b = rng.sample(range(4), 2)
+        _sync(cores[a], cores[b])
+        if _ % 10 == 9:
+            for c in cores[:3]:
+                c.run_consensus()
+
+    for c in cores[:3]:
+        c.run_consensus()
+
+    honest = cores[:3]
+    # every honest node detected the byzantine creator's fork
+    byz_cid = participants[byz_key.pub_hex]
+    for c in honest:
+        hg: ForkHashgraph = c.hg
+        det = __import__("numpy").asarray(hg._run()[1].det)
+        assert det[:, byz_cid].any(), "fork never detected"
+
+    # identical consensus prefixes across honest nodes
+    lists = [c.hg.consensus_events() for c in honest]
+    m = min(len(l) for l in lists)
+    assert m > 10, f"too little consensus progress: {[len(l) for l in lists]}"
+    for l in lists[1:]:
+        assert l[:m] == lists[0][:m], "consensus order diverged"
+
+
+def test_byzantine_core_rejects_bad_signature():
+    keys, participants, cores = _mk_cores(2)
+    stranger = generate_key()
+    ev = new_event([], ("", ""), stranger.pub_bytes, 0)
+    ev.sign(stranger)
+    with pytest.raises(ValueError):
+        cores[0].insert_event(ev)
