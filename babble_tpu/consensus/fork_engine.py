@@ -75,23 +75,53 @@ class ForkHashgraph:
 
     def known(self) -> Dict[int, int]:
         """Per-CREATOR event counts.  Under equivocation this vector
-        clock is approximate (two nodes can hold equally-sized but
-        different event sets for a byzantine creator); repeated random
-        gossip converges the sets, and the commit surface only ever
-        orders fully-propagated events.  Exact reconciliation would need
-        set digests — out of scope, like everywhere else (the reference
-        refuses forked streams outright)."""
+        clock is approximate: two nodes can hold equally-sized but
+        DIFFERENT event sets for a byzantine creator, and count-skip
+        diffs alone then wedge at a stable fixpoint that never exchanges
+        the symmetric difference (ADVICE r3 medium).  participant_events
+        self-heals in two layers:
+
+        1. tip exchange — when the peer's count is >= ours (suffix
+           empty), our chain tip for that creator is sent anyway.  Equal
+           sets drop it as a duplicate; diverged sets make the receiver
+           insert a foreign tip whose self-parent is not its local tip,
+           which IS the fork detection (ForkDag.insert allocates a
+           branch), collapsing the undetectable case to the detected one.
+        2. detected-fork resend — for creators with a locally detected
+           fork, diffs ignore count-skip past the earliest divergence
+           and resend the whole ambiguous suffix; receivers drop
+           duplicates by hash and random gossip converges the fleet."""
         return {
             cid: len(self.dag.cr_events[cid])
             for cid in self.participants.values()
         }
 
+    def _fork_suffix_start(self, cid: int) -> Optional[int]:
+        """Earliest divergence index of creator cid, or None if no fork
+        observed locally.  Events with seq < that index form the shared
+        linear prefix: topological insertion puts exactly those events in
+        the first ``div`` positions of cr_events (any seq>=div event on
+        either branch self-parent-chains through the whole prefix), so
+        count-skip is sound only there."""
+        dag = self.dag
+        alts = [
+            dag.br_div[c]
+            for c in range(cid * self.k, (cid + 1) * self.k)
+            if dag.br_used[c] and dag.br_parent[c] >= 0
+        ]
+        return min(alts) if alts else None
+
     def participant_events(self, pub: str, skip: int) -> List[str]:
         cid = self.participants[pub]
-        return [
-            self.dag.events[s].hex()
-            for s in self.dag.cr_events[cid][skip:]
-        ]
+        div = self._fork_suffix_start(cid)
+        if div is not None:
+            skip = min(skip, div)
+        slots = self.dag.cr_events[cid]
+        if slots and skip >= len(slots):
+            # equal-or-ahead count: send the tip anyway (see known()
+            # docstring, layer 1) so set divergence becomes detectable
+            return [self.dag.events[slots[-1]].hex()]
+        return [self.dag.events[s].hex() for s in slots[skip:]]
 
     def to_wire(self, event: Event) -> FullWireEvent:
         # the compact (creatorID, index) form is ambiguous under forks
@@ -118,7 +148,11 @@ class ForkHashgraph:
 
     @property
     def last_consensus_round(self) -> Optional[int]:
-        lcr = self.lcr
+        """Host mirror only (ADVICE r3): forcing ``self.lcr`` here would
+        trigger a whole-DAG device pipeline recompute from the stats path
+        and could race a concurrent consensus run.  The cache is advanced
+        by every _run(); use ``self.lcr`` to force a computation."""
+        lcr = self._lcr_cache
         return None if lcr < 0 else lcr
 
     def consensus_events_count(self) -> int:

@@ -533,9 +533,17 @@ def frontier_finalize(
     valid_e = (jnp.arange(e1) < state.n_events) & (state.seq >= 0)
     rnd = jnp.where(valid_e, rnd, -1)
 
+    # rolled windows: the pos table starts at round r_off, so an
+    # unordered laggard whose true round predates it would clamp to
+    # r_off-1 — keep its stored round/witness instead (exact: rounds
+    # are append-invariant).  No-op on fresh states (r_off == 0).
+    stale = valid_e & (state.round >= 0) & (state.round < state.r_off)
+    rnd = jnp.where(stale, state.round, rnd)
+
     wit = valid_e & (
         pos_table[jnp.clip(rnd - state.r_off, 0, r_cap), c_x] == wseq
     )
+    wit = jnp.where(stale, state.witness, wit)
 
     # exact witness table: chain j's round-r witness exists iff the
     # frontier strictly advances past it

@@ -273,8 +273,10 @@ def compact_impl(
         seq=state.seq[eidx],
         ts=state.ts[eidx],
         mbit=state.mbit[eidx],
-        la=state.la[eidx],
-        fd=state.fd[eidx],
+        # blocked wide states own la/fd as column blocks (ops/wide.py
+        # compact_block rolls those); here they are absent
+        la=state.la[eidx] if state.la is not None else None,
+        fd=state.fd[eidx] if state.fd is not None else None,
         round=state.round[eidx],
         witness=state.witness[eidx],
         rr=state.rr[eidx],

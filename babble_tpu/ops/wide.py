@@ -37,6 +37,31 @@ at dispatch time.
 
 Bit-parity with the fused single-jit pipeline is pinned by
 tests/test_wide.py at small shapes with forced blocking.
+
+Rolling-window support (VERDICT r3 item 5; ops/stream.py is the driver):
+the blocked la/fd store **window-local** seq values — ``abs_seq -
+s_off[col]`` — with a floor clamp at -1.  On fresh states (offsets zero)
+this is bit-identical to the old absolute convention, so every fresh-
+state parity test still pins the same tensors.  Under compaction:
+
+- la: any value < 0 means "no ancestor on this chain at or above the
+  window base".  The two un-windowed cases (no ancestor at all vs an
+  ancestor that rolled off) compare identically against every in-window
+  threshold, so one sentinel (-1) serves both.
+- fd: INF keeps "no descendant"; -1 means "first descendant below the
+  window base" — which still compares exactly in every consumer: the
+  strongly-see right side only ever gathers witness rows of live rounds
+  (their descendants have rounds >= r_off and therefore live in the
+  window — proven in ops/stream.py), and the order phase's
+  ``fd <= seq_w`` is exactly true for any below-window descendant.
+- one comparison family would be inexact — la vs fd when BOTH sides are
+  below-window — and it provably never occurs on witness rows; the
+  median kernel additionally reports a ``bad`` row count (below-window
+  fd selected by a newly-ordered row) that the stream driver asserts 0.
+
+All chain positions the march/fame/order kernels exchange (pos tables,
+bisect bounds, witness seqs) are window-local as well; compaction shifts
+block rows and rebases values per column in one gather+select program.
 """
 
 from __future__ import annotations
@@ -110,9 +135,11 @@ def _jits(cfg: DagConfig, C: int):
 
     write_batch = jax.jit(_write_batch, donate_argnums=(0,))
 
-    def _la_block_scan(sp, op, creator, seq, la_blk, slot_sched, blk_off):
+    def _la_block_scan(sp, op, creator, seq, s_off, la_blk, slot_sched,
+                       blk_off):
         """Whole-schedule la fill for one column block (fused scan; the
-        double-buffered carry is one block)."""
+        double-buffered carry is one block).  Own-seq writes are
+        window-local (module docstring)."""
         col = jnp.arange(w)
 
         def step(la, idx):
@@ -121,31 +148,35 @@ def _jits(cfg: DagConfig, C: int):
             rows = jnp.maximum(la[spx], la[opx])             # [B, w]
             own = creator[idx] - blk_off                     # block-local col
             own_here = (own >= 0) & (own < w)
+            seq_loc = seq[idx] - s_off[jnp.clip(creator[idx], 0, n)]
             rows = jnp.where(
                 own_here[:, None] & (col[None, :] == own[:, None]),
-                seq[idx, None].astype(rows.dtype), rows,
+                seq_loc[:, None].astype(rows.dtype), rows,
             )
             return la.at[idx].set(rows), None
 
         la_blk, _ = jax.lax.scan(step, la_blk, slot_sched)
         return set_sentinel(la_blk, e_row[:, None], -1)
 
-    la_block_scan = jax.jit(_la_block_scan, donate_argnums=(4,))
+    la_block_scan = jax.jit(_la_block_scan, donate_argnums=(5,))
 
-    def _fd_block_scan(sp, op, creator, seq, b_seq, b_k, n_events,
+    def _fd_block_scan(sp, op, creator, seq, s_off, b_seq, b_k, n_events,
                        fd_blk, slot_sched, blk_off):
         """Whole-schedule reversed fd fill for one column block,
-        including the own-seq seeding (_fd_init_own's block slice)."""
+        including the own-seq seeding (_fd_init_own's block slice;
+        window-local values)."""
         kpad = b_seq.shape[0]
         pos = jnp.arange(kpad, dtype=I32)
         real = pos < b_k
         slots = jnp.where(real, n_events - b_k + pos, e_cap)
-        own = jnp.where(real, creator[slots] - blk_off, -1)
-        own_here = (own >= 0) & (own < w)
+        own_c = jnp.where(real, creator[slots], n)
+        own = own_c - blk_off
+        own_here = (own >= 0) & (own < w) & real
+        b_seq_loc = b_seq - s_off[jnp.clip(own_c, 0, n)]
         fd_blk = fd_blk.at[
             jnp.where(own_here, slots, e_cap),
             jnp.clip(own, 0, w - 1),
-        ].set(b_seq.astype(fd_blk.dtype))
+        ].set(b_seq_loc.astype(fd_blk.dtype))
 
         def step(fd, idx):
             rows = fd[idx]                                   # [B, w]
@@ -157,7 +188,7 @@ def _jits(cfg: DagConfig, C: int):
         fd_blk, _ = jax.lax.scan(step, fd_blk, slot_sched[::-1])
         return set_sentinel(fd_blk, e_row[:, None], cfg.fd_inf)
 
-    fd_block_scan = jax.jit(_fd_block_scan, donate_argnums=(7,))
+    fd_block_scan = jax.jit(_fd_block_scan, donate_argnums=(8,))
 
     def _coord_sent(state):
         return ingest_ops._reset_coord_sentinels(
@@ -168,13 +199,38 @@ def _jits(cfg: DagConfig, C: int):
 
     # ---------------- blocked strongly-see partials ----------------
 
+    # one-hot band compression (ss.py module docstring): witness fd
+    # values cluster within ~1-2 rounds of each chain's frontier, so a
+    # per-column offset + a small static band cuts the matmul's
+    # S1-fold flop redundancy ~2-3x at deep windows.  The band check is
+    # a lax.cond: out-of-band calls fall back to the full-range matmul.
+    SS_BAND = 48
+
     def _ss_partial(rows_a, rows_b, acc):
         """acc += |{k in block : rows_a[a,k] >= rows_b[b,k]}| — exact
-        per-block partial of the strongly-see count."""
-        if _use_onehot_partial(cfg):
-            part = ss_counts_onehot(rows_a, rows_b, s_cap)
-        else:
-            part = ss_counts_compare(rows_a, rows_b)
+        per-block partial of the strongly-see count (rows_b are witness
+        fd rows: finite values are in-window by the stream eviction
+        proof, so the one-hot bucket range is [0, s_cap])."""
+        if not _use_onehot_partial(cfg):
+            return acc + ss_counts_compare(rows_a, rows_b)
+        if s_cap <= SS_BAND * 2:
+            return acc + ss_counts_onehot(rows_a, rows_b, s_cap)
+        inf = int(cfg.fd_inf)
+        finite = (rows_b >= 0) & (rows_b < inf)
+        col_min = jnp.min(
+            jnp.where(finite, rows_b.astype(I32), jnp.iinfo(I32).max),
+            axis=0,
+        )
+        off = jnp.where(col_min == jnp.iinfo(I32).max, 0, col_min)
+        in_band = jnp.where(
+            finite, rows_b.astype(I32) - off[None, :], 0
+        ) <= SS_BAND
+        part = jax.lax.cond(
+            in_band.all(),
+            lambda: ss_counts_onehot(rows_a, rows_b, SS_BAND,
+                                     off=off.astype(rows_b.dtype)),
+            lambda: ss_counts_onehot(rows_a, rows_b, s_cap),
+        )
         return acc + part
 
     ss_partial = jax.jit(_ss_partial, donate_argnums=(2,))
@@ -230,27 +286,61 @@ def _jits(cfg: DagConfig, C: int):
             out = jnp.where(cols < n, out, fill)
         return out
 
-    def _inherit_block(fde_blk, blk_off, s_off):
+    def _inherit_block(fde_blk):
         """Per-block descent inheritance: min over witnesses of their
-        first-inc events' fd rows, window-localized."""
-        m = fde_blk.min(axis=0).astype(I32)                  # [w] absolute
-        off = _col_gather(s_off, blk_off)
-        return jnp.where(
-            m >= int(cfg.fd_inf), jnp.iinfo(I32).max, m - off
-        )
+        first-inc events' fd rows (already window-local positions)."""
+        m = fde_blk.min(axis=0).astype(I32)                  # [w] local
+        return jnp.where(m >= int(cfg.fd_inf), jnp.iinfo(I32).max, m)
 
     inherit_block = jax.jit(_inherit_block)
 
-    def _frontier_next(cnt, pos, pos_table, r, s_star, found, inherit):
+    def _frontier_next(cnt, pos, pos_table, r, s_star, found, inherit,
+                       frozen, prev_next):
         pos_next = jnp.minimum(
             jnp.where(found, s_star, jnp.iinfo(I32).max), inherit
         )
         pos_next = jnp.maximum(pos_next, pos)  # monotone safety
+        # resumed march: positions found at an earlier march are frozen
+        # (old events' round criteria are append-invariant — stream.py)
+        pos_next = jnp.where(frozen, prev_next, pos_next)
         any_next = (pos_next < cnt).any()
         pos_table = pos_table.at[jnp.minimum(r + 1, r_cap)].set(pos_next)
         return pos_next, pos_table, any_next
 
     frontier_next = jax.jit(_frontier_next, donate_argnums=(2,))
+
+    def _march_bounds(pos_r, prev_next, cnt, cnt_prev):
+        """Bisect bounds for one resumed march step: frozen chains pin
+        lo=hi at their known position; open chains search only the
+        events appended since the last march (window-local).  On fresh
+        runs (cnt_prev=0) this degenerates to the original full-range
+        bounds bit-exactly."""
+        frozen = prev_next < cnt_prev
+        valid_w = pos_r < cnt
+        lo_u = jnp.where(valid_w, jnp.maximum(pos_r, cnt_prev), cnt)
+        lo = jnp.where(frozen, prev_next, lo_u)
+        hi = jnp.where(frozen, prev_next, cnt)
+        span = jnp.max(jnp.maximum(hi - lo, 0))
+        return frozen, lo, hi, span
+
+    march_bounds = jax.jit(_march_bounds)
+
+    def _march_open(pos_table, cnt_prev):
+        """Per-round-row openness: a row is closed once every chain's
+        position was found before the last march (then no appended event
+        can change it)."""
+        return (pos_table >= cnt_prev[None, :]).any(axis=1)
+
+    march_open = jax.jit(_march_open)
+
+    def _wit_seq_loc(state_seq, state_s_off, ws):
+        """Window-local witness seqs per creator column — ws is creator-
+        indexed ([N] or [R, N]), so column k subtracts s_off[k].
+        Sentinel rows yield negatives, masked by the callers' validity
+        masks."""
+        return state_seq[sanitize(ws, e_cap)] - state_s_off[:n]
+
+    wit_seq_loc = jax.jit(_wit_seq_loc)
 
     def _frontier_fin(state, pos_table):
         state = ingest_ops.frontier_finalize(state, cfg, pos_table)
@@ -269,6 +359,19 @@ def _jits(cfg: DagConfig, C: int):
         return ws, ws >= 0
 
     fame_wits = jax.jit(_fame_wits)
+
+    def _head_round_min(state):
+        """Smallest chain-head round over all minted chains: rounds are
+        monotone along a chain, so round i's witness set is FINAL iff
+        every chain's head round >= i.  Mid-stream fame gates decisions
+        on this (ops/stream.py), which makes streaming scheduling-
+        invariant and bit-identical to the whole-DAG batch."""
+        cnt_w = state.cnt[:n] - state.s_off[:n]
+        heads = state.ce[jnp.arange(n), jnp.clip(cnt_w - 1, 0, s_cap)]
+        hr = state.round[sanitize(jnp.where(cnt_w > 0, heads, -1), e_cap)]
+        return jnp.min(jnp.where(state.cnt[:n] > 0, hr, -1))
+
+    head_round_min = jax.jit(_head_round_min)
 
     def _votes0_block(la1_blk_rows, seqw_i, blk_off, valid_1, valid_i):
         """Block-columns of the d=1 direct see votes."""
@@ -331,7 +434,8 @@ def _jits(cfg: DagConfig, C: int):
         R = r_cap
         wsl = state.wslot[:R]
         valid_w = wsl >= 0
-        seqw = state.seq[sanitize(wsl, e_cap)]
+        # window-local witness seqs (fd block values are local too)
+        seqw = state.seq[sanitize(wsl, e_cap)] - state.s_off[None, :n]
         fam = (state.famous[:R] == fame_ops.FAME_TRUE) & valid_w
         decided = (
             (~valid_w) | (state.famous[:R] != fame_ops.FAME_UNDEFINED)
@@ -417,8 +521,11 @@ def _jits(cfg: DagConfig, C: int):
         sw = _col_gather_t(seqw, blk_off)[i_rows]            # [chunk, w]
         fm = _col_gather_t(fam, blk_off, fill=False)[i_rows]
         sees = fm & (fd_blk_rows <= sw)
-        off = _col_gather(state.s_off, blk_off)
-        fdc = jnp.clip(fd_blk_rows - off[None, :], 0, s_cap)
+        # below-window fd selected by a seer: the ts grid can't resolve
+        # it (the event rolled off) — counted and asserted 0 upstream
+        # for newly-ordered rows (module docstring)
+        bad = (sees & (fd_blk_rows < 0)).any(axis=1)
+        fdc = jnp.clip(fd_blk_rows, 0, s_cap)
         if jax.default_backend() == "tpu" and s_cap < 2048:
             def acc_step(s, acc):
                 return jnp.where(fdc == s, ts_grid[:, s][None, :], acc)
@@ -429,7 +536,7 @@ def _jits(cfg: DagConfig, C: int):
             )
         else:
             tv = ts_grid[jnp.arange(w)[None, :], fdc]
-        return jnp.where(sees, tv, inf), sees.sum(axis=1, dtype=I32)
+        return jnp.where(sees, tv, inf), sees.sum(axis=1, dtype=I32), bad
 
     med_tv_block = jax.jit(_med_tv_block, static_argnums=(8,))
 
@@ -455,6 +562,48 @@ def _jits(cfg: DagConfig, C: int):
 
     write_rows = jax.jit(_write_rows)
 
+    # ---------------- rolling-window compaction ----------------
+
+    def _compact_block(blk, de, ds_cols, is_fd):
+        """Shift a coordinate block down by de rows (tail back-fills from
+        the sentinel row, like state.compact_impl) and rebase values to
+        the new window base: local -= ds, floored at -1 ("below
+        window").  la negatives and fd INF are fixpoints."""
+        eidx = jnp.minimum(jnp.arange(e_cap + 1) + de, e_cap)
+        v = blk[eidx]
+        shifted = jnp.maximum(v.astype(I32) - ds_cols[None, :], -1)
+        if is_fd:
+            keep = v.astype(I32) >= int(cfg.fd_inf)
+        else:
+            keep = v < 0
+        return jnp.where(keep, v, shifted.astype(v.dtype))
+
+    compact_block = jax.jit(_compact_block, static_argnums=(3,),
+                            donate_argnums=(0,))
+
+    def _compact_march(pos_table, cnt_prev, dr, ds):
+        """Roll the march carry: round rows shift by dr (row r_cap is
+        never written by the march, so the clamp back-fills INF), and
+        window-local positions rebase by each chain's seq shift."""
+        inf = jnp.iinfo(I32).max
+        ridx = jnp.minimum(jnp.arange(r_cap + 1) + dr, r_cap)
+        pt = pos_table[ridx]
+        pt = jnp.where(pt == inf, inf, jnp.maximum(pt - ds[None, :], 0))
+        return pt, jnp.maximum(cnt_prev - ds, 0)
+
+    compact_march = jax.jit(_compact_march, donate_argnums=(0,))
+
+    def _newly_range(newly):
+        """[lo, hi) slot bounds of the newly-ordered rows (the median
+        only needs to stream those; INT32_MAX/-1 when empty)."""
+        idx = jnp.arange(newly.shape[0])
+        inf = jnp.iinfo(I32).max
+        lo = jnp.min(jnp.where(newly, idx, inf))
+        hi = jnp.max(jnp.where(newly, idx, -1)) + 1
+        return lo, hi
+
+    newly_range = jax.jit(_newly_range)
+
     return dict(
         write_batch=write_batch, la_block_scan=la_block_scan,
         fd_block_scan=fd_block_scan, coord_sent=coord_sent,
@@ -462,27 +611,32 @@ def _jits(cfg: DagConfig, C: int):
         frontier_prep=frontier_prep, round_witnesses=round_witnesses,
         bisect_candidates=bisect_candidates, bisect_update=bisect_update,
         inherit_block=inherit_block, frontier_next=frontier_next,
+        march_bounds=march_bounds, march_open=march_open,
+        wit_seq_loc=wit_seq_loc,
         frontier_fin=frontier_fin,
-        fame_wits=fame_wits, votes0_block=votes0_block,
+        fame_wits=fame_wits, head_round_min=head_round_min,
+        votes0_block=votes0_block,
         fame_tally=fame_tally, fame_write=fame_write, fame_fin=fame_fin,
         order_prep=order_prep, sees_partial_block=sees_partial_block,
         order_rr_update=order_rr_update, med_tv_block=med_tv_block,
         ts_range=ts_range,
         med_reduce=med_reduce, slice_rows=slice_rows,
         write_rows=write_rows, med_chunk=med_chunk, width=w,
+        compact_block=compact_block, compact_march=compact_march,
+        newly_range=newly_range,
     )
 
 
-def _assert_fresh(state: DagState) -> None:
-    """The wide pipeline is batch-only: it uses window-local seq
-    invariants (one-hot strongly-see, block offsets) and indexes witness
-    rows by absolute round, so rolled-window states are out of contract
-    (the live engine drives the fused kernels with batch_window=False)."""
-    if int(state.r_off) != 0:
-        raise ValueError(
-            "wide pipeline requires a fresh (un-compacted) state; "
-            f"got r_off={int(state.r_off)}"
-        )
+class MarchCarry:
+    """Persistent frontier-march state for windowed streaming
+    (ops/stream.py): the per-round first-position table plus the chain
+    lengths at the last march (what freezes already-found positions)."""
+
+    __slots__ = ("pos_table", "cnt_prev")
+
+    def __init__(self, pos_table, cnt_prev):
+        self.pos_table = pos_table
+        self.cnt_prev = cnt_prev
 
 
 def _init_blocks(cfg: DagConfig, C: int):
@@ -516,25 +670,41 @@ def _assemble_blocks(cfg: DagConfig, blocks) -> jnp.ndarray:
 
 
 def run_wide_coords(cfg: DagConfig, state: DagState, batch: EventBatch,
-                    la_blocks, fd_blocks, C: int):
-    """Blocked coordinate fill: batch write + per-block la/fd scans."""
+                    la_blocks, fd_blocks, C: int, fd_slot_sched=None):
+    """Blocked coordinate fill: batch write + per-block la/fd scans
+    (window-local values; exact on fresh states where offsets are 0).
+
+    ``fd_slot_sched`` (streaming): a level schedule of WINDOW slots the
+    reversed fd sweep must cover.  An la row is final at insert (an
+    event's ancestors are fixed), so la scans only the batch — but fd
+    rows keep gaining first-descendants until every chain has one, and
+    a batch-only reverse scan would propagate new descendants just one
+    hop into pre-batch history (observed as a stalled frontier march:
+    round-r witnesses never learned of their next-batch descendants
+    through pre-batch intermediaries).  Min is idempotent and rows
+    never forget, so re-sweeping all live levels reaches the exact
+    transitive closure.  Default (one-shot batch): the batch schedule
+    IS the whole window."""
     j = _jits(cfg, C)
     state = j["write_batch"](state, batch)
     base = state.n_events - batch.k
     slot_sched = jnp.where(
         batch.sched >= 0, base + batch.sched, cfg.e_cap
     )
+    if fd_slot_sched is None:
+        fd_slot_sched = slot_sched
     w = j["width"]
     sp, op, creator, seq = state.sp, state.op, state.creator, state.seq
+    s_off = state.s_off
     la_blocks = tuple(
-        j["la_block_scan"](sp, op, creator, seq, la_blocks[c],
+        j["la_block_scan"](sp, op, creator, seq, s_off, la_blocks[c],
                            slot_sched, jnp.asarray(c * w, I32))
         for c in range(C)
     )
     fd_blocks = tuple(
-        j["fd_block_scan"](sp, op, creator, seq, batch.seq, batch.k,
-                           state.n_events, fd_blocks[c], slot_sched,
-                           jnp.asarray(c * w, I32))
+        j["fd_block_scan"](sp, op, creator, seq, s_off, batch.seq,
+                           batch.k, state.n_events, fd_blocks[c],
+                           fd_slot_sched, jnp.asarray(c * w, I32))
         for c in range(C)
     )
     state = j["coord_sent"](state)
@@ -553,30 +723,53 @@ def _blocked_ss(j, C, w, la_rows_by_block, fd_rows_by_block, n):
 
 
 def run_wide_rounds(cfg: DagConfig, state: DagState, la_blocks,
-                    fd_blocks, C: int, stats=None) -> DagState:
+                    fd_blocks, C: int, stats=None,
+                    carry: Optional[MarchCarry] = None) -> DagState:
     """Blocked host-driven frontier march (device twin:
-    _rounds_frontier, differentially tested)."""
-    _assert_fresh(state)
+    _rounds_frontier, differentially tested).
+
+    With ``carry`` (windowed streaming) the march resumes: rows whose
+    positions were all found at the last march are frozen — appended
+    events cannot change them, because an event's round criterion only
+    counts ancestor witnesses (ops/stream.py "append-invariance") — and
+    open rows bisect only over the appended suffix.  The carry is
+    updated in place (pos_table/cnt_prev) for the next resume."""
     j = _jits(cfg, C)
     w = j["width"]
     n, s_cap, r_cap = cfg.n, cfg.s_cap, cfg.r_cap
-    bisect_iters = max(1, (s_cap + 1).bit_length())
 
-    cnt, pos, pos_table = j["frontier_prep"](state)
-    r = 0
+    cnt, pos0, pos_table0 = j["frontier_prep"](state)
+    if carry is None:
+        pos_table = pos_table0
+        cnt_prev = jnp.zeros((n,), I32)
+        r = 0
+    else:
+        # refresh row 0 (chains empty at the last march may be live now)
+        pos_table = carry.pos_table.at[0].set(pos0)
+        cnt_prev = carry.cnt_prev
+        open_rows = np.asarray(j["march_open"](pos_table, cnt_prev))
+        first_open = int(np.argmax(open_rows)) if open_rows.any() else 0
+        r = max(0, first_open - 1)
+    pos = pos_table[r]
+
+    steps = 0
     alive = True
     while alive and r < r_cap - 1:
+        frozen, lo, hi, span = j["march_bounds"](
+            pos, pos_table[r + 1], cnt, cnt_prev
+        )
         ws, valid_w = j["round_witnesses"](state, cnt, pos)
         fdw = [j["gather_rows"](fd_blocks[c], ws) for c in range(C)]
 
-        lo = jnp.where(valid_w, pos, cnt)
-        hi = cnt
+        bisect_iters = max(1, int(span).bit_length())
         for _ in range(bisect_iters):
             mid, xs = j["bisect_candidates"](state, lo, hi)
             law = [j["gather_rows"](la_blocks[c], xs) for c in range(C)]
             cnt_ab = _blocked_ss(j, C, w, law, fdw, n)
             lo, hi = j["bisect_update"](cnt_ab, valid_w, lo, hi, mid,
                                         cnt)
+        if stats is not None:
+            stats["ss_tallies"] = stats.get("ss_tallies", 0) + bisect_iters
         s_star = lo
         found = s_star < cnt
 
@@ -584,41 +777,57 @@ def run_wide_rounds(cfg: DagConfig, state: DagState, la_blocks,
         _, e_star = j["bisect_candidates"](state, s_star, s_star)
         e_star = jnp.where(found, e_star, -1)
         inh = [
-            j["inherit_block"](
-                j["gather_rows"](fd_blocks[c], e_star),
-                jnp.asarray(c * w, I32), state.s_off,
-            )
+            j["inherit_block"](j["gather_rows"](fd_blocks[c], e_star))
             for c in range(C)
         ]
         inherit = jnp.concatenate(inh)[:n]
         pos, pos_table, any_next = j["frontier_next"](
             cnt, pos, pos_table, jnp.asarray(r, I32), s_star, found,
-            inherit,
+            inherit, frozen, pos_table[r + 1],
         )
         alive = bool(any_next)
         r += 1
+        steps += 1
 
     if stats is not None:
-        stats["round_steps"] = r
-        stats["bisect_iters"] = bisect_iters
+        stats["round_steps"] = stats.get("round_steps", 0) + steps
+        stats["bisect_iters"] = max(1, (s_cap + 1).bit_length())
+    if carry is not None:
+        carry.pos_table = pos_table
+        carry.cnt_prev = cnt
     return j["frontier_fin"](state, pos_table)
 
 
 def run_wide_fame(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
-                  C: int, stats=None) -> DagState:
+                  C: int, stats=None, complete: bool = True) -> DagState:
     """Blocked host-driven fame voting (device twin:
-    decide_fame_block_impl, differentially tested)."""
-    _assert_fresh(state)
+    decide_fame_block_impl, differentially tested).  Round indices into
+    the witness/fame tables are window rows (i_abs - r_off); witness
+    seqs are window-local to match the blocked coordinates.
+
+    ``complete=False`` (mid-stream): decisions are gated to rounds
+    whose witness set is provably final (every chain head's round >= i
+    — _head_round_min), so a late witness can never reopen a decided
+    round and the stream's output is bit-identical to the whole-DAG
+    batch regardless of batch boundaries.  Fame decisions themselves
+    are stable under late *voters* (the supermajority threshold is
+    absolute), so gating the subject round is sufficient."""
     j = _jits(cfg, C)
     w = j["width"]
     n = cfg.n
     lcr = int(state.lcr)
     max_round = int(state.max_round)
+    r_off = int(state.r_off)
+    hi = max_round
+    if not complete:
+        hi = min(hi, int(j["head_round_min"](state)) + 1)
     famous = state.famous
-    for i_abs in range(max(lcr + 1, 0), max_round):
-        i = i_abs  # r_off == 0 asserted
+    for i_abs in range(max(lcr + 1, r_off), hi):
+        i = i_abs - r_off
+        if i >= cfg.r_cap:
+            break
         ws_i, valid_i = j["fame_wits"](state, jnp.asarray(i, I32))
-        seqw_i = state.seq[sanitize(ws_i, cfg.e_cap)]
+        seqw_i = j["wit_seq_loc"](state.seq, state.s_off, ws_i)
         famous_i = famous[i]
 
         ws_1, valid_1 = j["fame_wits"](state, jnp.asarray(i + 1, I32))
@@ -668,17 +877,31 @@ def run_wide_fame(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
 
 
 def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
-                   C: int, stats=None) -> DagState:
+                   C: int, stats=None,
+                   r_lo_abs: Optional[int] = None,
+                   r_hi_abs: Optional[int] = None) -> DagState:
     """Blocked host-driven round-received + median timestamps (device
-    twin: decide_order_impl, differentially tested)."""
-    _assert_fresh(state)
+    twin: decide_order_impl, differentially tested).
+
+    ``r_lo_abs``/``r_hi_abs`` restrict the round-received scan to the
+    absolute rounds decided since the last call (windowed streaming):
+    rounds decided earlier already tested every event then present, and
+    later-arriving events can never be received there (a witness cannot
+    see an event inserted after it — ops/stream.py).  Default: all
+    window rows (the batch path).  The median pass streams only the
+    slot range containing newly-received rows."""
     j = _jits(cfg, C)
     w = j["width"]
     n, e1 = cfg.n, cfg.e_cap + 1
+    r_off = int(state.r_off)
+    lo_r = 0 if r_lo_abs is None else max(0, r_lo_abs - r_off)
+    hi_r = cfg.r_cap if r_hi_abs is None else min(
+        cfg.r_cap, r_hi_abs - r_off + 1
+    )
     seqw, fam, decided, has_w, fam_cnt, und = j["order_prep"](state)
 
     rr = state.rr
-    for i in range(cfg.r_cap):
+    for i in range(lo_r, hi_r):
         c = jnp.zeros((e1,), I32)
         for blk in range(C):
             c = j["sees_partial_block"](
@@ -690,6 +913,17 @@ def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
     newly = und & (rr != -1)
     i_of = jnp.clip(rr - state.r_off, 0, cfg.r_cap - 1)
 
+    # only the slot range holding newly-received rows needs the median
+    n_lo, n_hi = j["newly_range"](newly)
+    n_lo, n_hi = int(n_lo), int(n_hi)
+    if n_hi <= n_lo:
+        if stats is not None:   # accumulate-only: streaming reuses stats
+            stats.setdefault("median_chunks", 0)
+            stats.setdefault("median_chunk_rows", j["med_chunk"])
+            stats.setdefault("median_rel32", True)
+            stats.setdefault("median_bad_rows", 0)
+        return state._replace(rr=rr)
+
     tmin, tmax, div1000 = j["ts_range"](state)
     span = int(np.asarray(tmax - tmin))
     scale = 1000 if (bool(np.asarray(div1000))
@@ -698,33 +932,45 @@ def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
     rel32 = span // scale < (1 << 31) - 1
     scale_j = jnp.asarray(scale, jnp.int64)
     cts = state.cts
-    chunk = j["med_chunk"]
-    for k, e0 in enumerate(range(0, e1, chunk)):
-        e0 = min(e0, e1 - chunk) if e1 >= chunk else 0
+    chunk = min(j["med_chunk"], e1)
+    bad_total = jnp.zeros((), I32)
+    n_chunks = 0
+    for k, e0 in enumerate(range(n_lo, n_hi, chunk)):
+        e0 = min(e0, e1 - chunk)
         e0j = jnp.asarray(e0, I32)
         i_rows = j["slice_rows"](i_of, e0j, chunk)
+        new_rows = j["slice_rows"](newly, e0j, chunk)
         tvs, cnts = [], []
         for blk in range(C):
             fd_rows = j["slice_rows"](fd_blocks[blk], e0j, chunk)
-            tv_b, cnt_b = j["med_tv_block"](
+            tv_b, cnt_b, bad_b = j["med_tv_block"](
                 state, fd_rows, i_rows, seqw, fam,
                 jnp.asarray(blk * w, I32), tmin, scale_j, rel32,
             )
             tvs.append(tv_b)
             cnts.append(cnt_b)
+            bad_total = bad_total + (bad_b & new_rows).sum(dtype=I32)
         tv_full = jnp.concatenate(tvs, axis=1)[:, :n]
         cnt_s = sum(cnts[1:], cnts[0])
-        new_rows = j["slice_rows"](newly, e0j, chunk)
         cts_rows = j["slice_rows"](cts, e0j, chunk)
         upd = j["med_reduce"](tv_full, cnt_s, new_rows, cts_rows, tmin,
                               scale_j, rel32)
         cts = j["write_rows"](cts, e0j, upd)
+        n_chunks += 1
         if k % 8 == 7:
             _ = np.asarray(cts[:1])      # dispatch backpressure
+    bad = int(bad_total)
+    if bad:
+        raise AssertionError(
+            f"median read {bad} below-window first-descendants for "
+            "newly-ordered rows — eviction policy violated "
+            "(ops/stream.py margin contract)"
+        )
     if stats is not None:
-        stats["median_chunks"] = -(-e1 // chunk)
+        stats["median_chunks"] = stats.get("median_chunks", 0) + n_chunks
         stats["median_chunk_rows"] = chunk
         stats["median_rel32"] = rel32
+        stats["median_bad_rows"] = stats.get("median_bad_rows", 0)
     return state._replace(rr=rr, cts=cts)
 
 
@@ -762,7 +1008,11 @@ def run_wide_pipeline(
 
     if state is None:
         state = init_state(cfg, include_coords=False)
-    _assert_fresh(state)
+    if int(state.r_off) != 0 or int(state.e_off) != 0:
+        raise ValueError(
+            "run_wide_pipeline is the one-shot batch wrapper; drive "
+            "compacted/windowed states through ops.stream.WideStream"
+        )
     # discard the fused-layout coordinate tensors: the wide path owns
     # its blocked twins (split is only needed when resuming mid-state,
     # which the batch pipeline never does — state is fresh)

@@ -122,3 +122,109 @@ def test_byzantine_core_rejects_bad_signature():
     ev.sign(stranger)
     with pytest.raises(ValueError):
         cores[0].insert_event(ev)
+
+
+def test_byzantine_diff_self_heals_equal_count_wedge():
+    """ADVICE r3 medium: count-skip diffs wedge when two peers hold
+    equally-sized but different event sets for a forked creator.  The
+    tip-exchange layer (ForkHashgraph.known docstring) makes the wedge
+    self-detecting: at equal counts the sender's tip rides along, the
+    receiver's insert of a foreign tip allocates a fork branch, and the
+    detected-fork resend then ships the whole ambiguous suffix."""
+    keys, participants, cores = _mk_cores(4)
+    byz_id = 3
+    byz_key = keys[byz_id]
+    for a in range(4):
+        for b in range(4):
+            if a != b:
+                _sync(cores[a], cores[b])
+
+    # fork off the shared TIP of the byz chain: each branch extends the
+    # holder's linear view, so neither 0 nor 1 can detect anything —
+    # the genuinely undetectable pairwise wedge
+    byz_cid = participants[byz_key.pub_hex]
+    tip0 = cores[0].hg.dag.events[cores[0].hg.dag.cr_events[byz_cid][-1]]
+    tip1 = cores[1].hg.dag.events[cores[1].hg.dag.cr_events[byz_cid][-1]]
+    assert tip0.hex() == tip1.hex(), "warm-up should leave a shared tip"
+    fork_a = new_event([b"wa"], (tip0.hex(), cores[0].head),
+                       byz_key.pub_bytes, tip0.index + 1)
+    fork_a.sign(byz_key)
+    fork_b = new_event([b"wb"], (tip0.hex(), cores[1].head),
+                       byz_key.pub_bytes, tip0.index + 1)
+    fork_b.sign(byz_key)
+    cores[0].insert_event(fork_a)
+    cores[1].insert_event(fork_b)
+    assert cores[0].hg._fork_suffix_start(byz_cid) is None
+    assert cores[1].hg._fork_suffix_start(byz_cid) is None
+
+    # the wedge precondition: 0 and 1 hold equal counts but different
+    # sets for the byz creator, and neither can see a fork locally
+    assert cores[0].known()[byz_cid] == cores[1].known()[byz_cid]
+    d01 = [e.hex() for e in cores[0].diff(cores[1].known())]
+    assert fork_a.hex() in d01, "tip exchange missing from the diff"
+
+    # pairwise heal: one exchange each way — 1 inserts 0's foreign tip
+    # (fork detected), then its detected-fork resend gives 0 branch b
+    _sync(cores[0], cores[1])
+    assert cores[1].hg._fork_suffix_start(byz_cid) is not None
+    _sync(cores[1], cores[0])
+    for c in (cores[0], cores[1]):
+        slots = c.hg.dag.cr_events[byz_cid]
+        hexes = {c.hg.dag.events[s].hex() for s in slots}
+        assert {fork_a.hex(), fork_b.hex()} <= hexes, "wedge did not heal"
+        assert c.hg._fork_suffix_start(byz_cid) is not None
+
+
+def test_byzantine_sync_skips_bad_events():
+    """ADVICE r3: one fork-budget violation in a sync response must not
+    drop the valid events of other creators nor the merge head."""
+    keys, participants, cores = _mk_cores(4)
+    byz_key = keys[3]
+    for a in range(4):
+        for b in range(4):
+            if a != b:
+                _sync(cores[a], cores[b])
+
+    _sync(cores[1], cores[0])   # core0 must know core1's current head
+    root_hex = cores[3].hg.dag.events[
+        cores[3].hg.dag.cr_events[participants[byz_key.pub_hex]][0]
+    ].hex()
+    forks = []
+    for tag in (b"a", b"b"):
+        f = new_event([tag], (root_hex, cores[0].head),
+                      byz_key.pub_bytes, 1)
+        f.sign(byz_key)
+        forks.append(f)
+    # k=2 = main + one alt branch: core0 accepts the first fork; the
+    # second exceeds the budget and must not poison the honest event
+    # shipped in the same response
+    cores[0].insert_event(forks[0])
+
+    honest = new_event([b"tx"], (cores[1].head, cores[0].head),
+                       keys[1].pub_bytes, cores[1].seq + 1)
+    honest.sign(keys[1])
+
+    wire = [FullWireEvent.from_event(forks[1]),
+            FullWireEvent.from_event(honest)]
+    old_seq = cores[0].seq
+    cores[0].sync(cores[1].head, wire, [])
+    assert cores[0].insert_failures == 1
+    assert "fork" in (cores[0].last_insert_error or "").lower() or \
+        "exceeded" in (cores[0].last_insert_error or "")
+    assert honest.hex() in cores[0].hg.dag.slot_of, "valid event dropped"
+    assert cores[0].seq == old_seq + 1, "merge head not created"
+
+
+def test_byzantine_stats_never_touch_device(monkeypatch):
+    """ADVICE r3: the stats path must use the host lcr mirror, never
+    force a device pipeline run."""
+    keys, participants, cores = _mk_cores(4)
+
+    def boom(self):
+        raise AssertionError("stats path triggered a device run")
+
+    monkeypatch.setattr(ForkHashgraph, "_run", boom)
+    c = cores[0]
+    assert c.last_consensus_round() is None
+    snap = c.stats_snapshot()
+    assert snap["last_consensus_round"] == -1

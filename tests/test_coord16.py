@@ -106,3 +106,50 @@ def test_coord16_engine_and_checkpoint(tmp_path):
     eng2 = load_checkpoint(str(path))
     assert eng2.cfg.coord16 is True
     assert eng2.consensus_events() == engines[True].consensus_events()
+
+
+def test_coord8_overflow_guard_covers_pending_batch():
+    """ADVICE r3: a pending batch that crosses the narrow-coordinate
+    headroom must raise OverflowError at flush — before any device
+    write could wrap int8 la/fd values.  Host chains include pending
+    events (OffsetList length is absolute), so the guard's head count
+    sees the whole batch."""
+    from babble_tpu.consensus.engine import TpuHashgraph
+    from babble_tpu.core.event import new_event
+    from babble_tpu.crypto.keys import generate_key
+
+    keys = sorted((generate_key() for _ in range(2)),
+                  key=lambda k: k.pub_hex)
+    participants = {k.pub_hex: i for i, k in enumerate(keys)}
+    from babble_tpu.ops.state import init_state
+
+    eng = TpuHashgraph(participants, verify_signatures=False,
+                       e_cap=256, s_cap=110, r_cap=16)
+    eng.cfg = eng.cfg._replace(coord8=True)
+    eng.state = init_state(eng.cfg)
+
+    heads = {}
+    for i, k in enumerate(keys):
+        ev = new_event([], ("", ""), k.pub_bytes, 0)
+        ev.sign(k)
+        eng.insert_event(ev)
+        heads[i] = ev.hex()
+    key0 = keys[0]
+    seq = 0
+    for q in range(1, 92):
+        ev = new_event([], (heads[0], heads[1]), key0.pub_bytes, q)
+        ev.sign(key0)
+        eng.insert_event(ev)
+        heads[0] = ev.hex()
+        seq = q
+    eng.flush()   # safe: head seq 91 below the int8 sentinel headroom
+
+    for q in range(seq + 1, seq + 40):   # batch spans the 126 edge
+        ev = new_event([], (heads[0], heads[1]), key0.pub_bytes, q)
+        ev.sign(key0)
+        eng.insert_event(ev)
+        heads[0] = ev.hex()
+    import pytest as _pytest
+
+    with _pytest.raises(OverflowError):
+        eng.flush()
