@@ -6,16 +6,30 @@ TpuHashgraph.  Differentially tested against consensus/byzantine.py
 (the definition-first oracle) on forked DAGs, and against the honest
 engine on fork-free DAGs.
 
-Execution model is whole-DAG batch: each run_consensus() call re-runs the
-pipeline over everything inserted so far from a fresh device state.  That
-matches the byzantine bench shape (BASELINE "1024-node, 1/3 forks").
+Execution model is whole-WINDOW batch: each run_consensus() call re-runs
+the pipeline over the live window from a fresh device state.  That
+matches the byzantine bench shape (BASELINE "1024-node, 1/3 forks") and,
+with the rolling window (VERDICT r3 weak #4), bounds a live node's
+per-tick cost forever:
 
-Live scope: the engine now exposes the full Core surface (known/diff/
+- ``maybe_compact`` evicts the longest committed slot prefix whose
+  rounds sit below lcr - round_margin, that is seq_window chain indexes
+  behind every branch tip, and that no unordered event still needs for
+  its median timestamp (the per-branch min-fd bound).  Slot order is a
+  chain prefix on every branch, so chain INDEX values (eseq, cp, la/fd
+  units) stay absolute and nothing rebases.
+- round and witness status are functions of an event's fixed ancestry,
+  so values computed once are final: the engine seeds them back into
+  the next run (ForkBatch.rseed/wseed) and the rounds closure only
+  assigns events inserted since.  Rounds are window-local; r_off maps
+  them back to absolute for commits and stats.
+- fixed window capacities mean fixed jit shapes: a long-lived byzantine
+  node compiles the pipeline once instead of re-compiling at every
+  bucketed growth.
+
+Live scope: the engine exposes the full Core surface (known/diff/
 full-event wire form/commit counters), so a node can run byzantine mode
-end to end (Config.byzantine); the per-consensus cost is whole-window
-batch, amortized by the node's consensus cadence, and memory is bounded
-only by the run's history — the honest engine's rolling-window eviction
-does not yet apply here (see README "Byzantine mode" scope note).
+end to end (Config.byzantine).
 """
 
 from __future__ import annotations
@@ -43,19 +57,28 @@ class ForkHashgraph:
         k: int = 2,
         commit_callback=None,
         verify_signatures: bool = False,
+        auto_compact: bool = False,
+        round_margin: int = 1,
+        seq_window: int = 16,
+        compact_min: int = 64,
     ):
         self.participants = participants
         self.k = k
         self.dag = ForkDag(participants, k=k)
         self.commit_callback = commit_callback
         self.verify_signatures = verify_signatures
+        self.auto_compact = auto_compact
+        self.round_margin = round_margin
+        self.seq_window = seq_window
+        self.compact_min = compact_min
         self.consensus: List[str] = []
         self.consensus_transactions = 0
         self.last_committed_round_events = 0
-        self._received: set = set()
+        self._received: set = set()     # event hexes already ordered
         self._out = None
         self._dirty = True
         self._lcr_cache = -1    # host mirror: /Stats must never touch device
+        self._caps = (0, 0, 0)  # monotone (e_cap, s_cap, r_cap) — see _run
 
     @property
     def n(self) -> int:
@@ -92,7 +115,7 @@ class ForkHashgraph:
            and resend the whole ambiguous suffix; receivers drop
            duplicates by hash and random gossip converges the fleet."""
         return {
-            cid: len(self.dag.cr_events[cid])
+            cid: self.dag.cr_evicted[cid] + len(self.dag.cr_events[cid])
             for cid in self.participants.values()
         }
 
@@ -112,16 +135,28 @@ class ForkHashgraph:
         return min(alts) if alts else None
 
     def participant_events(self, pub: str, skip: int) -> List[str]:
+        from ..common import TooLateError
+
         cid = self.participants[pub]
+        evicted = self.dag.cr_evicted[cid]
         div = self._fork_suffix_start(cid)
         if div is not None:
-            skip = min(skip, div)
+            # detected-fork resend reaches at most down to the window
+            # base (anything below is committed on both sides)
+            skip = min(skip, max(div, evicted))
         slots = self.dag.cr_events[cid]
-        if slots and skip >= len(slots):
+        if skip < evicted:
+            # the peer is below the rolling window; byzantine mode has
+            # no fast-forward (node.py refusal), so it cannot catch up
+            # through this sync path
+            raise TooLateError(skip)
+        if slots and skip >= evicted + len(slots):
             # equal-or-ahead count: send the tip anyway (see known()
             # docstring, layer 1) so set divergence becomes detectable
             return [self.dag.events[slots[-1]].hex()]
-        return [self.dag.events[s].hex() for s in slots[skip:]]
+        return [
+            self.dag.events[s].hex() for s in slots[skip - evicted:]
+        ]
 
     def to_wire(self, event: Event) -> FullWireEvent:
         # the compact (creatorID, index) form is ambiguous under forks
@@ -165,7 +200,7 @@ class ForkHashgraph:
             "consensus_events": len(self.consensus),
             "consensus_transactions": self.consensus_transactions,
             "last_committed_round_events": self.last_committed_round_events,
-            "evicted_events": 0,      # no rolling window in batch mode
+            "evicted_events": self.dag.evicted,
             "live_window": len(self.dag.events),
         }
 
@@ -174,23 +209,57 @@ class ForkHashgraph:
     def _run(self):
         if not self._dirty and self._out is not None:
             return self._out
-        ne = len(self.dag.events)
+        dag = self.dag
+        ne = len(dag.events)
         max_chain = max(
-            (len(self.dag._chain_slots(c))
-             for c in range(self.dag.b) if self.dag.br_used[c]),
+            (len(dag._chain_slots(c))
+             for c in range(dag.b) if dag.br_used[c]),
             default=0,
         )
-        max_lvl = max(self.dag.levels, default=0)
-        cfg = ForkConfig(
-            n=self.n, k=self.k,
-            e_cap=_bucket(ne),
-            s_cap=_bucket(max_chain + 1, 8),
-            r_cap=_bucket(max_lvl + 2, 8),
+        # window-local round capacity: seeded top + headroom for the
+        # new levels (a level lifts the max round by at most one, and in
+        # practice a round spans several levels)
+        prev_top = max(
+            (r - dag.r_off for r in dag.rseed if r >= 0), default=0
         )
-        batch = self.dag.build_batch(cfg)
-        self._out = (cfg, fork_pipeline(cfg, batch))
+        lvl_new = len({dag.levels[s] for s in range(ne)
+                       if dag.rseed[s] < 0})
+        r_cap = _bucket(prev_top + 2 + min(lvl_new, max(8, lvl_new // 3)),
+                        8)
+        # monotone capacities: every distinct shape is a full pipeline
+        # re-jit, so caps only ever grow (the rolling window keeps the
+        # fixpoint small; without monotonicity the r_cap heuristic flaps
+        # between buckets and a 4-node fleet on one core spends minutes
+        # per tick inside XLA)
+        e_cap = max(self._caps[0], _bucket(ne))
+        s_cap = max(self._caps[1], _bucket(max_chain + 1, 8))
+        r_cap = max(self._caps[2], r_cap)
+        while True:
+            self._caps = (e_cap, s_cap, r_cap)
+            cfg = ForkConfig(
+                n=self.n, k=self.k,
+                e_cap=e_cap,
+                s_cap=s_cap,
+                r_cap=r_cap,
+            )
+            batch = self.dag.build_batch(cfg)
+            out = fork_pipeline(cfg, batch)
+            if int(np.asarray(out.max_round)) < cfg.r_cap - 1:
+                break
+            r_cap *= 2      # saturated: recompute with headroom
+        self._out = (cfg, out)
         self._dirty = False
-        self._lcr_cache = int(np.asarray(self._out[1].lcr))
+        lcr_loc = int(np.asarray(out.lcr))
+        if lcr_loc >= 0:
+            self._lcr_cache = max(self._lcr_cache, lcr_loc + dag.r_off)
+        # seed back: rounds/witness are ancestry-fixed, so this run's
+        # assignments are final and the next run skips them
+        rnd = np.asarray(out.round[:ne])
+        wit = np.asarray(out.witness[:ne])
+        for s in range(ne):
+            if rnd[s] >= 0:
+                dag.rseed[s] = int(rnd[s]) + dag.r_off
+                dag.wseed[s] = int(wit[s])
         return self._out
 
     # ------------------------------------------------------------------
@@ -201,7 +270,7 @@ class ForkHashgraph:
 
     def round(self, x: str) -> int:
         cfg, out = self._run()
-        return int(np.asarray(out.round)[self._slot(x)])
+        return int(np.asarray(out.round)[self._slot(x)]) + self.dag.r_off
 
     def witness(self, x: str) -> bool:
         cfg, out = self._run()
@@ -224,30 +293,32 @@ class ForkHashgraph:
 
     def famous_of(self, r: int, x: str) -> Optional[bool]:
         cfg, out = self._run()
-        if r < 0 or r >= cfg.r_cap:
+        r_loc = r - self.dag.r_off
+        if r_loc < 0 or r_loc >= cfg.r_cap:
             return None
         wslot = np.asarray(out.wslot)
         famous = np.asarray(out.famous)
         sx = self._slot(x)
         for col in range(cfg.b):
-            if wslot[r, col] == sx:
-                f = famous[r, col]
+            if wslot[r_loc, col] == sx:
+                f = famous[r_loc, col]
                 return None if f == FAME_UNDEFINED else bool(f == FAME_TRUE)
         return None
 
     def max_round(self) -> int:
         cfg, out = self._run()
-        return int(np.asarray(out.max_round))
+        return int(np.asarray(out.max_round)) + self.dag.r_off
 
     @property
     def lcr(self) -> int:
-        cfg, out = self._run()
-        return int(np.asarray(out.lcr))
+        self._run()
+        return self._lcr_cache
 
     # ------------------------------------------------------------------
 
     def run_consensus(self) -> List[Event]:
         cfg, out = self._run()
+        r_off = self.dag.r_off
         rr = np.asarray(out.rr)
         cts = np.asarray(out.cts)
         wslot = np.asarray(out.wslot)
@@ -256,38 +327,103 @@ class ForkHashgraph:
 
         new_events: List[Event] = []
         for s in range(ne):
-            if rr[s] < 0 or s in self._received:
+            if rr[s] < 0:
                 continue
             ev = self.dag.events[s]
-            ev.round_received = int(rr[s])
+            if ev.hex() in self._received:
+                continue
+            ev.round_received = int(rr[s]) + r_off
             ev.consensus_timestamp = int(cts[s])
             new_events.append(ev)
-            self._received.add(s)
+            self._received.add(ev.hex())
         if not new_events:
+            if self.auto_compact:
+                self.maybe_compact()
             return []
 
         def prn(r: int) -> int:
-            if r < 0 or r >= cfg.r_cap:
+            r_loc = r - r_off
+            if r_loc < 0 or r_loc >= cfg.r_cap:
                 return 0
             res = 0
             for col in range(cfg.b):
-                if wslot[r, col] >= 0 and famous[r, col] == FAME_TRUE:
-                    res ^= int(self.dag.events[int(wslot[r, col])].hex(), 16)
+                if wslot[r_loc, col] >= 0 and famous[r_loc, col] == FAME_TRUE:
+                    res ^= int(
+                        self.dag.events[int(wslot[r_loc, col])].hex(), 16
+                    )
             return res
 
         new_events = consensus_sort(new_events, prn)
         for ev in new_events:
             self.consensus.append(ev.hex())
             self.consensus_transactions += len(ev.transactions)
-        lcr = int(np.asarray(out.lcr))
+        lcr = self._lcr_cache
         if lcr >= 1:
             rnd = np.asarray(out.round)[:ne]
             self.last_committed_round_events = int(
-                np.count_nonzero(rnd == lcr - 1)
+                np.count_nonzero(rnd + r_off == lcr - 1)
             )
         if self.commit_callback is not None:
             self.commit_callback(new_events)
+        if self.auto_compact:
+            self.maybe_compact()
         return new_events
+
+    # ------------------------------------------------------------------
+    # rolling window (module docstring; honest analogue:
+    # consensus/engine.py maybe_compact over caches.go:45-76 semantics)
+
+    def maybe_compact(self, force: bool = False) -> int:
+        """Evict the longest committed slot prefix nothing live needs:
+        ordered, round below lcr - round_margin, seq_window chain
+        indexes behind every branch tip, and strictly below the
+        smallest first-descendant any UNORDERED event still holds on
+        that branch (so median timestamps keep resolving).  Returns the
+        number of evicted slots."""
+        if self._out is None or self._dirty:
+            return 0
+        cfg, out = self._out
+        dag = self.dag
+        ne = len(dag.events)
+        if ne == 0:
+            return 0
+        r_off = dag.r_off
+        new_r_off_target = self._lcr_cache - self.round_margin
+        rr = np.asarray(out.rr[:ne])
+        rnd = np.asarray(out.round[:ne]) + r_off
+        fd = np.asarray(out.fd[:ne])
+        eseq = np.fromiter(
+            (ev.index for ev in dag.events), np.int64, ne
+        )
+        ebr = np.asarray(dag.ebr[:ne])
+        # per-branch safety bounds
+        unordered = rr < 0
+        m_fd = np.full(cfg.b, np.iinfo(np.int64).max)
+        if unordered.any():
+            fd_u = np.where(
+                fd[unordered] >= np.iinfo(np.int32).max,
+                np.iinfo(np.int64).max, fd[unordered].astype(np.int64),
+            )
+            m_fd = fd_u.min(axis=0)
+        tip_idx = np.asarray(dag.br_extent) - 1
+        ebr_c = np.clip(ebr, 0, cfg.b - 1)
+        ok = (
+            (rr >= 0)
+            & (rnd < new_r_off_target)
+            & (eseq < m_fd[ebr_c])
+            & (eseq <= tip_idx[ebr_c] - self.seq_window)
+        )
+        k = int(np.argmin(ok)) if not ok.all() else ne
+        new_r_off = int(rnd[k:].min(initial=new_r_off_target))
+        new_r_off = max(r_off, min(new_r_off, new_r_off_target))
+        if (k < self.compact_min and not force) and new_r_off == r_off:
+            return 0
+        for s in range(k):
+            self._received.discard(dag.events[s].hex())
+        dag.evict_prefix(k, new_r_off)
+        self._out = None
+        self._dirty = True
+        return k
 
     def consensus_events(self) -> List[str]:
         return list(self.consensus)

@@ -47,14 +47,18 @@ class Core:
             # and discounted instead of rejected (ops/forks.py); gossip
             # ships the self-contained FullWireEvent form because the
             # compact (creatorID, index) references are ambiguous under
-            # forks.  Batch execution per consensus tick — see the README
-            # scope note for the window/memory contract.
+            # forks.  Batch execution per consensus tick over a rolling
+            # window (fork_engine.maybe_compact) keeps per-tick cost and
+            # jit shapes bounded forever.
             from ..consensus.fork_engine import ForkHashgraph
 
             self.hg = ForkHashgraph(
                 participants, k=fork_k,
                 commit_callback=commit_callback,
                 verify_signatures=True,
+                auto_compact=bool(cache_size),
+                seq_window=min(seq_window or cache_size or 256, 256),
+                compact_min=max((cache_size or 256) // 4, 32),
             )
         else:
             # The live path runs with rolling windows on (auto_compact):

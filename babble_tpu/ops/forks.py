@@ -77,7 +77,16 @@ class ForkConfig(NamedTuple):
 
 
 class ForkBatch(NamedTuple):
-    """Whole-DAG host-built arrays (slots = insertion order)."""
+    """Whole-DAG host-built arrays (slots = insertion order).
+
+    ``rseed``/``wseed`` support the rolling live window
+    (fork_engine.maybe_compact): both round and witness status are
+    functions of an event's fixed ancestry, so values computed in an
+    earlier run are final and seed the next run — the closure then only
+    assigns NEW events, and events whose parents were evicted keep
+    exact rounds.  Seeds are window-LOCAL rounds (absolute - r_off,
+    with r_off = the minimum retained round so every seed is >= 0);
+    -1 = not yet computed."""
 
     sp: jnp.ndarray       # i32[E+1] self-parent slot, -1 (sentinel row incl.)
     op: jnp.ndarray       # i32[E+1]
@@ -92,6 +101,9 @@ class ForkBatch(NamedTuple):
     cnt: jnp.ndarray      # i32[B] chain lengths (0 for unused branch slots)
     owner: jnp.ndarray    # bool[B, S+1] position is owned (assigned) by b
     n_events: jnp.ndarray # i32
+    rseed: jnp.ndarray    # i32[E+1] seeded window-local round, -1 unknown
+    wseed: jnp.ndarray    # i8[E+1]  seeded witness trilean (-1/0/1)
+    s_off: jnp.ndarray    # i32[B] absolute chain index of window position 0
 
 
 class ForkOut(NamedTuple):
@@ -143,6 +155,18 @@ class ForkDag:
     _chain_tip: Dict[int, int] = field(default_factory=dict)   # col -> tip slot
     # per-CREATOR slots in insertion order (the gossip Known/diff view)
     cr_events: List[List[int]] = field(init=False)
+    # rolling-window seeds (ForkBatch docstring): ABSOLUTE round and
+    # witness trilean per slot, -1 until the pipeline computes them;
+    # r_off = absolute round of window row 0; evicted = total dropped
+    rseed: List[int] = field(default_factory=list)
+    wseed: List[int] = field(default_factory=list)
+    r_off: int = 0
+    evicted: int = 0
+    # absolute chain extent per branch (max index + 1) — survives
+    # eviction, unlike window lengths
+    br_extent: List[int] = field(init=False)
+    # per-CREATOR evicted counts: the gossip vector clock stays absolute
+    cr_evicted: List[int] = field(init=False)
 
     def __post_init__(self):
         n = len(self.participants)
@@ -153,6 +177,8 @@ class ForkDag:
         self.br_events = [[] for _ in range(b)]
         self.br_used = [False] * b
         self.cr_events = [[] for _ in range(n)]
+        self.br_extent = [0] * b
+        self.cr_evicted = [0] * n
 
     @property
     def n(self) -> int:
@@ -207,13 +233,16 @@ class ForkDag:
                 self.br_div[col] = event.index
         self.events.append(event)
         self.slot_of[x] = slot
-        event.topological_index = slot
+        event.topological_index = self.evicted + slot
         self.cr_events[cid].append(slot)
         self.sp_slot.append(sps)
         self.op_slot.append(ops)
         self.ebr.append(col)
         self.br_events[col].append(slot)
         self._chain_tip[col] = slot
+        self.br_extent[col] = max(self.br_extent[col], event.index + 1)
+        self.rseed.append(-1)
+        self.wseed.append(-1)
         lvl = 0
         if sps >= 0 or ops >= 0:
             lvl = 1 + max(
@@ -222,6 +251,48 @@ class ForkDag:
             )
         self.levels.append(lvl)
         return slot
+
+    # ------------------------------------------------------------------
+
+    def evict_prefix(self, k: int, new_r_off: int) -> None:
+        """Drop the first k slots (a committed prefix the engine proved
+        safe — fork_engine.maybe_compact) and rebase slot references.
+        Slot order is insertion order and chain positions ascend with
+        slot, so a slot prefix is a chain prefix on every branch; chain
+        INDEX values (eseq, cp, la/fd units) are absolute and survive
+        unchanged.  Evicted parents become -1: the pipeline treats such
+        events as pseudo-roots whose round/witness come from rseed/wseed
+        instead of the root rule."""
+        if k <= 0:
+            self.r_off = new_r_off
+            return
+        for s in range(k):
+            del self.slot_of[self.events[s].hex()]
+        self.events = self.events[k:]
+        self.levels = self.levels[k:]
+        self.rseed = self.rseed[k:]
+        self.wseed = self.wseed[k:]
+
+        def remap(v: int) -> int:
+            return v - k if v >= k else -1
+
+        self.sp_slot = [remap(v) for v in self.sp_slot[k:]]
+        self.op_slot = [remap(v) for v in self.op_slot[k:]]
+        self.ebr = self.ebr[k:]
+        for h in list(self.slot_of):
+            self.slot_of[h] -= k
+        self.br_events = [
+            [s - k for s in lst if s >= k] for lst in self.br_events
+        ]
+        for cid, lst in enumerate(self.cr_events):
+            kept = [s - k for s in lst if s >= k]
+            self.cr_evicted[cid] += len(lst) - len(kept)
+            self.cr_events[cid] = kept
+        self._chain_tip = {
+            col: s - k for col, s in self._chain_tip.items() if s >= k
+        }
+        self.evicted += k
+        self.r_off = new_r_off
 
     # ------------------------------------------------------------------
 
@@ -257,8 +328,9 @@ class ForkDag:
             return list(reversed(p))
 
         paths = [path(c) if self.br_used[c] else [] for c in range(b)]
-        lens = [len(self._chain_slots(c)) if self.br_used[c] else 0
-                for c in range(b)]
+        # ABSOLUTE chain extents: window lengths would understate
+        # divergence fallbacks after prefix eviction
+        lens = list(self.br_extent)
         for b1 in range(b):
             if not self.br_used[b1]:
                 continue
@@ -323,6 +395,7 @@ class ForkDag:
         ce = np.full((B, s1), -1, np.int32)
         owner = np.zeros((B, s1), bool)
         cnt = np.zeros(B, np.int32)
+        s_off = np.zeros(B, np.int32)
         for col in range(B):
             if not self.br_used[col]:
                 continue
@@ -330,9 +403,20 @@ class ForkDag:
             assert len(chain) <= cfg.s_cap, "s_cap too small"
             ce[col, : len(chain)] = chain
             cnt[col] = len(chain)
+            # window positions map to absolute chain indexes by a per-
+            # branch offset (contiguous: prefix eviction drops a chain
+            # prefix, and chain indexes step by one)
+            s_off[col] = self.events[chain[0]].index if chain else 0
             for i, s in enumerate(chain):
                 owner[col, i] = self.ebr[s] == col
 
+        rseed = np.full(e1, -1, np.int32)
+        wseed = np.full(e1, -1, np.int8)
+        if self.rseed is not None:
+            for s in range(ne):
+                if self.rseed[s] >= 0:
+                    rseed[s] = self.rseed[s] - self.r_off
+                    wseed[s] = self.wseed[s]
         return ForkBatch(
             sp=jnp.asarray(sp), op=jnp.asarray(op), ebr=jnp.asarray(ebr),
             eseq=jnp.asarray(eseq), ecr=jnp.asarray(ecr),
@@ -340,6 +424,8 @@ class ForkDag:
             sched=jnp.asarray(sched), cp=jnp.asarray(self.common_prefix()),
             ce=jnp.asarray(ce), cnt=jnp.asarray(cnt),
             owner=jnp.asarray(owner), n_events=jnp.asarray(ne, jnp.int32),
+            rseed=jnp.asarray(rseed), wseed=jnp.asarray(wseed),
+            s_off=jnp.asarray(s_off),
         )
 
 
@@ -388,13 +474,17 @@ def _detect(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray) -> jnp.ndarray:
 
 
 def _first_det(cfg: ForkConfig, b: ForkBatch, det: jnp.ndarray) -> jnp.ndarray:
-    """first_det[br, c]: first chain index on branch br whose event detects
-    a fork by c (INT32_MAX if none).  Detection is monotone along a chain,
-    so it's a count of the False prefix."""
+    """first_det[br, c]: first ABSOLUTE chain index on branch br whose
+    event detects a fork by c (INT32_MAX if none).  Detection is
+    monotone along a chain, so it's a count of the False prefix plus the
+    branch's window offset.  Window note: a detection by an EVICTED
+    prefix event would be missed here, but eviction only drops ordered
+    events below the round window, whose detection cut-offs only affect
+    already-decided rounds."""
     dchain = det[sanitize(b.ce, cfg.e_cap)]                   # [B, S+1, N]
     live = (jnp.arange(cfg.s_cap + 1)[None, :] < b.cnt[:, None])
     pre = (~dchain) & live[:, :, None]
-    first = pre.sum(axis=1, dtype=I32)                        # [B, N]
+    first = pre.sum(axis=1, dtype=I32) + b.s_off[:, None]     # [B, N]
     hit = (dchain & live[:, :, None]).any(axis=1)
     return jnp.where(hit, first, INT32_MAX)
 
@@ -472,15 +562,24 @@ def _fd_chains(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray) -> jnp.ndarray:
             (s_idx[None, :] < cnt_c[:, None])[:, :, None], V, INT32_MAX
         )
 
-        def count_chunk(t0, V=V):
+        s_off_c = jax.lax.dynamic_slice(
+            jnp.concatenate([b.s_off, jnp.zeros(cbpad - B, I32)]), (c0,),
+            (cb,),
+        )
+
+        def count_chunk(t0, V=V, s_off_c=s_off_c):
+            # thresholds are ABSOLUTE target-chain indexes (window
+            # position t on chain `by` is index t + s_off[by])
             t_idx = t0 + jnp.arange(tc)
-            lt = V[:, :, :, None] < t_idx[None, None, None, :]
+            thr = t_idx[None, None, None, :] + b.s_off[None, None, :, None]
+            lt = V[:, :, :, None] < thr
             return lt.sum(axis=1, dtype=I32)                  # [Cb, B, Tc]
 
         counts = jax.lax.map(count_chunk, jnp.arange(n_tc) * tc)
         out = jnp.moveaxis(counts, 0, 2).reshape(cb, B, tpad)[:, :, :t_total]
         found = out < cnt_c[:, None, None]
-        out = jnp.where(found, out, INT32_MAX)                # [Cb, B(by), T]
+        # counts are window positions on the source chain -> absolute
+        out = jnp.where(found, out + s_off_c[:, None, None], INT32_MAX)
 
         # land this chunk's columns: fd[ce[by, t], c0:c0+cb] = out[br, by, t]
         block = jnp.full((e1, cb), INT32_MAX, I32)
@@ -563,8 +662,30 @@ def _rounds_closure(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray,
     spx = sanitize(b.sp, cfg.e_cap)
     opx = sanitize(b.op, cfg.e_cap)
 
+    # seeds (rolling window): rounds/witness status are ancestry-fixed,
+    # so values from earlier runs pre-assign the retained prefix and the
+    # loop only decides events inserted since (ForkBatch docstring)
+    seeded = valid_e & (b.rseed >= 0)
+    rnd0 = jnp.where(seeded, b.rseed, -1)
+    cex = sanitize(b.ce, cfg.e_cap)                          # [B, S+1]
+    live_chain = (jnp.arange(s_cap + 1)[None, :] < b.cnt[:, None])
+
+    # pre-populate witness rows from seeds: one owned witness per
+    # (branch, seeded round)
+    w_chain = (b.wseed[cex] == 1) & b.owner & live_chain \
+        & (b.rseed[cex] >= 0)
+    w_round = jnp.where(w_chain, b.rseed[cex], r_cap)        # dump row
+    wslot0 = jnp.full((r_cap + 1, B), -1, I32)
+    wslot0 = wslot0.at[
+        jnp.clip(w_round, 0, r_cap), rows[:, None].repeat(s_cap + 1, 1)
+    ].max(jnp.where(w_chain, b.ce, -1))
+
     def round_step(carry):
-        r, rnd, unassigned, pos, wslot, alive = carry
+        r, rnd, unassigned, wslot, alive = carry
+        # candidate frontier: first chain position with round >= r
+        # (rounds are monotone along chains; seeded prefixes count too)
+        rnd_chain = jnp.where(live_chain, rnd[cex], -1)
+        pos = ((rnd_chain >= 0) & (rnd_chain < r)).sum(-1, dtype=I32)
         valid_w = pos < b.cnt
         ws = b.ce[rows, jnp.clip(pos, 0, s_cap)]
         wsx = sanitize(jnp.where(valid_w, ws, -1), cfg.e_cap)
@@ -578,7 +699,11 @@ def _rounds_closure(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray,
         ss_c = ss[..., 0::k]
         for kk in range(1, k):
             ss_c = ss_c | ss[..., kk::k]
-        S = unassigned & (ss_c.sum(-1) >= sm)
+        # parent rounds above r also lift (rounds are monotone through
+        # parent edges) — this is what lets seeded boundaries skip the
+        # rounds the window no longer has full ancestry for
+        pr_gt = jnp.maximum(rnd[spx], rnd[opx]) > r
+        S = unassigned & ((ss_c.sum(-1) >= sm) | pr_gt)
 
         # descent closure of S within the unassigned set
         def cl_body(c):
@@ -595,38 +720,32 @@ def _rounds_closure(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray,
         rnd = jnp.where(newly, r, rnd)
 
         # witness table row r: the frontier event, when it was assigned
-        # round r and the branch owns the position
+        # round r and the branch owns the position (keep seeded entries
+        # of other branches in the row)
         owner_w = b.owner[rows, jnp.clip(pos, 0, s_cap)]
         is_w = valid_w & newly[wsx] & owner_w
-        wslot = wslot.at[jnp.minimum(r, r_cap)].set(
-            jnp.where(is_w, ws, -1)
-        )
+        row = jnp.minimum(r, r_cap)
+        wslot = wslot.at[row].set(jnp.where(is_w, ws, wslot[row]))
 
-        # frontier advance: assigned rounds are chain prefixes
-        assigned_on_chain = (
-            rnd[sanitize(b.ce[:, : s_cap + 1], cfg.e_cap)] >= 0
-        ) & (b.ce[:, : s_cap + 1] >= 0)
-        pos = assigned_on_chain.sum(-1, dtype=I32)
         alive = D.any()
-        return r + 1, rnd, D, pos, wslot, alive
+        return r + 1, rnd, D, wslot, alive
 
     def cond(carry):
-        r, _, _, _, _, alive = carry
+        r, _, _, _, alive = carry
         # rounds 0..r_cap-1 are assignable (wslot rows 0..r_cap-1, same
         # as the level scan); `r < r_cap - 1` here was an off-by-one that
         # silently dropped the top round at tight capacities
         return alive & (r < r_cap)
 
-    rnd0 = jnp.full((e1,), -1, I32)
-    wslot0 = jnp.full((r_cap + 1, B), -1, I32)
-    pos0 = jnp.zeros((B,), I32)
-    _, rnd, _, _, wslot, _ = jax.lax.while_loop(
+    unassigned0 = valid_e & ~seeded
+    _, rnd, _, wslot, _ = jax.lax.while_loop(
         cond, round_step,
-        (jnp.asarray(0, I32), rnd0, valid_e, pos0, wslot0,
+        (jnp.asarray(0, I32), rnd0, unassigned0, wslot0,
          jnp.asarray(True)),
     )
 
     wit = valid_e & ((b.sp < 0) | (rnd > rnd[spx]))
+    wit = jnp.where(b.wseed >= 0, b.wseed == 1, wit) & valid_e
     max_round = jnp.max(jnp.where(valid_e, rnd, -1))
     return rnd, wit, wslot, max_round
 
@@ -830,9 +949,10 @@ def _order(cfg: ForkConfig, b: ForkBatch, fd: jnp.ndarray,
     sees_i = fam_i & (fd <= seqw_i) & (seqw_i < fdet_x)       # [E+1, B]
 
     # tv[x, br] = ts of chain-br's event at index fd[x, br] (the oldest
-    # self-ancestor of that branch's witness to see x)
+    # self-ancestor of that branch's witness to see x); the ts grid is
+    # positional, so absolute fd indexes shift by the window offset
     ts_grid = b.ts[sanitize(b.ce, cfg.e_cap)]                 # i64[B, S+1]
-    fdc = jnp.clip(fd, 0, cfg.s_cap)
+    fdc = jnp.clip(fd - b.s_off[None, :], 0, cfg.s_cap)
     INT64_MAX = jnp.iinfo(jnp.int64).max
 
     def acc_step(s, acc):

@@ -141,6 +141,7 @@ def fork_batch_specs():
         sp=ev, op=ev, ebr=ev, eseq=ev, ecr=ev, ts=ev, mbit=ev,
         sched=P(), cp=P("p", None), ce=P("p", None), cnt=P("p"),
         owner=P("p", None), n_events=P(),
+        rseed=ev, wseed=ev, s_off=P("p"),
     )
 
 
@@ -178,6 +179,7 @@ def pad_fork_for_mesh(cfg, batch, mesh: Mesh):
         ebr=pad1(batch.ebr, cfg.b), eseq=pad1(batch.eseq, -1),
         ecr=pad1(batch.ecr, cfg.n), ts=pad1(batch.ts, 0),
         mbit=pad1(batch.mbit, False),
+        rseed=pad1(batch.rseed, -1), wseed=pad1(batch.wseed, -1),
     )
     return cfg._replace(e_cap=e1_new - 1), batch
 

@@ -417,5 +417,8 @@ def random_byzantine_fork_batch(
         cnt=jnp.asarray(cnt),
         owner=jnp.asarray(owner),
         n_events=jnp.asarray(n_events, np.int32),
+        rseed=jnp.full(e1, -1, np.int32),
+        wseed=jnp.full(e1, -1, np.int8),
+        s_off=jnp.zeros(b_total, np.int32),
     )
     return cfg, batch

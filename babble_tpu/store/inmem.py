@@ -1,14 +1,21 @@
-"""In-memory store: LRU events/rounds + rolling consensus log + per-creator
-event sequences (reference: hashgraph/inmem_store.go, hashgraph/caches.go,
-hashgraph/roundInfo.go).
+"""In-memory store: the reference's 14-method Store seam
+(hashgraph/inmem_store.go, hashgraph/caches.go, hashgraph/roundInfo.go)
+over the SAME host state the production engine indexes.
 
-Role note: this is the *reference-shaped* store, used by the differential
-oracle (consensus/oracle.py) so its storage semantics — LRU windows,
-RollingList eviction, ErrTooLate — match the Go engine it mirrors.  The
-production path stores host state in core/dag.py's HostDag, whose
-OffsetList windows implement the same TooLate contract but are driven by
-consensus progress (engine.maybe_compact) instead of cache size, in
-lockstep with the device tensors' rolling windows (ops/state.py).
+Event storage, per-creator chain views, rolling windows and the TooLate
+contract all live in core/dag.py's HostDag — one implementation for
+both engines (the oracle reads through this Store facade; the TPU
+engine indexes HostDag directly and keeps its device tensors in
+lockstep).  What remains here is the reference-shaped annex the oracle
+needs and the production engine keeps elsewhere: RoundInfo fame maps
+(device twin: wslot/famous tensors) and the rolling consensus log
+(engine.consensus OffsetList).
+
+Eviction is prefix-based at cache_size: on this append-only workload
+insertion order is the LRU order, and prefix eviction is exactly the
+OffsetList window contract the engine's maybe_compact drives
+(caches.go:45-76 analogue) — reads below the window raise TooLateError
+either way.
 """
 
 from __future__ import annotations
@@ -86,90 +93,65 @@ class Store(Protocol):
     def round_events(self, r: int) -> int: ...
 
 
-class _ParticipantEventsCache:
-    """participant -> RollingList of event hashes (reference caches.go:20-115)."""
-
-    def __init__(self, size: int, participants: Dict[str, int]):
-        self.size = size
-        self.participants = participants
-        self._events: Dict[str, RollingList] = {
-            pk: RollingList(size) for pk in participants
-        }
-
-    def get(self, participant: str, skip: int) -> List[str]:
-        pe = self._events.get(participant)
-        if pe is None:
-            raise KeyNotFoundError(participant)
-        cached, tot = pe.get()
-        if skip >= tot:
-            return []
-        oldest_cached = tot - len(cached)
-        if skip < oldest_cached:
-            # Reference leaves disk spill unimplemented (caches.go:59-61);
-            # callers treat this as "peer must catch up elsewhere".
-            raise TooLateError(skip)
-        start = skip - oldest_cached
-        return list(cached[start:])
-
-    def get_item(self, participant: str, index: int) -> str:
-        pe = self._events.get(participant)
-        if pe is None:
-            raise KeyNotFoundError(participant)
-        return pe.get_item(index)
-
-    def get_last(self, participant: str) -> str:
-        pe = self._events.get(participant)
-        if pe is None:
-            raise KeyNotFoundError(participant)
-        cached, _ = pe.get()
-        return cached[-1] if cached else ""
-
-    def add(self, participant: str, hash_: str) -> None:
-        pe = self._events.setdefault(participant, RollingList(self.size))
-        pe.add(hash_)
-
-    def known(self) -> Dict[int, int]:
-        return {
-            self.participants[p]: evs.get()[1] for p, evs in self._events.items()
-        }
-
-
 class InmemStore:
-    """Sole host-side Store implementation (reference inmem_store.go:20-142)."""
+    """Sole host-side Store implementation (reference inmem_store.go:
+    20-142), backed by core.dag.HostDag — the one host-state structure
+    both engines share (module docstring)."""
 
-    def __init__(self, participants: Dict[str, int], cache_size: int):
+    def __init__(self, participants: Dict[str, int], cache_size: int,
+                 dag=None):
+        from ..core.dag import HostDag
+
         self._cache_size = cache_size
-        self._event_cache = LRU(cache_size)
+        self.participants = participants
+        # signature checks are the engines' concern (both oracle and
+        # TpuHashgraph gate them before set_event/insert)
+        self.dag = dag if dag is not None else HostDag(
+            participants, verify_signatures=False
+        )
         self._round_cache = LRU(cache_size)
         self._consensus_cache = RollingList(cache_size)
-        self._participant_events = _ParticipantEventsCache(cache_size, participants)
 
     def cache_size(self) -> int:
         return self._cache_size
 
     def get_event(self, key: str) -> Event:
-        ev, ok = self._event_cache.get(key)
-        if not ok:
+        s = self.dag.slot_of.get(key)
+        if s is None:
             raise KeyNotFoundError(key)
-        return ev
+        return self.dag.events[s]
 
     def set_event(self, event: Event) -> None:
-        key = event.hex()
-        if key not in self._event_cache:
-            self._participant_events.add(event.creator, key)
-        self._event_cache.add(key, event)
+        if event.hex() in self.dag.slot_of:
+            return          # annotation update; objects are shared
+        self.dag.insert(event)
+        # no device consumer behind this seam; don't grow the queue
+        self.dag.pending.clear()
+        live = self.dag.n_events - self.dag.slot_base
+        if live > self._cache_size:
+            self.dag.evict_prefix(self.dag.n_events - self._cache_size)
 
     def participant_events(self, participant: str, skip: int) -> List[str]:
-        return self._participant_events.get(participant, skip)
+        if participant not in self.participants:
+            raise KeyNotFoundError(participant)
+        return self.dag.participant_events(participant, skip)
 
     def participant_event(self, participant: str, index: int) -> str:
-        return self._participant_events.get_item(participant, index)
+        cid = self.participants.get(participant)
+        if cid is None:
+            raise KeyNotFoundError(participant)
+        chain = self.dag.chains[cid]
+        if index >= len(chain):
+            raise KeyNotFoundError((participant, index))
+        return self.dag.events[chain[index]].hex()
 
     def last_from(self, participant: str) -> str:
-        return self._participant_events.get_last(participant)
+        if participant not in self.participants:
+            raise KeyNotFoundError(participant)
+        return self.dag.last_from(participant)
 
     def known(self) -> Dict[int, int]:
-        return self._participant_events.known()
+        return self.dag.known()
 
     def consensus_events(self) -> List[str]:
         window, _ = self._consensus_cache.get()

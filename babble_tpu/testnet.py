@@ -102,6 +102,21 @@ class TestnetRunner:
         if self.jax_platform:
             env["JAX_PLATFORMS"] = self.jax_platform
             env["BABBLE_JAX_PLATFORM"] = self.jax_platform
+            if self.jax_platform == "cpu":
+                # CPU nodes must not dial the TPU relay at interpreter
+                # start (sitecustomize registers the plugin whenever
+                # this is set): a down/busy relay would hang every node
+                # at boot, and the relay serializes clients anyway
+                env["PALLAS_AXON_POOL_IPS"] = ""
+        if "--jax_cache" not in self.extra_node_args:
+            # one SHARED jit cache for the whole fleet: N same-shape
+            # nodes on one host otherwise each pay every compile (on a
+            # 1-core box that serializes to minutes per shape)
+            shared = os.path.join(self.base_dir, "jax_cache_shared")
+            os.makedirs(shared, exist_ok=True)
+            self.extra_node_args = list(self.extra_node_args) + [
+                "--jax_cache", shared
+            ]
         for i in range(self.n):
             p = self.ports.of(i)
             d = os.path.join(self.base_dir, f"node{i}")

