@@ -34,6 +34,25 @@ def cmd_keygen(args) -> int:
     return 0
 
 
+def _parse_fork_caps(spec: str):
+    """'e,s,r' -> (e, s, r), failing at the flag instead of as a bare
+    IndexError inside the consensus loop."""
+    if not spec:
+        return None
+    parts = spec.split(",")
+    if len(parts) != 3:
+        raise SystemExit(
+            f"--fork_caps wants exactly 'e,s,r' (got {spec!r})"
+        )
+    try:
+        caps = tuple(int(x) for x in parts)
+    except ValueError:
+        raise SystemExit(f"--fork_caps values must be integers: {spec!r}")
+    if any(v <= 0 for v in caps):
+        raise SystemExit(f"--fork_caps values must be positive: {spec!r}")
+    return caps
+
+
 async def _run_node(args) -> int:
     import os
 
@@ -69,8 +88,25 @@ async def _run_node(args) -> int:
         from .store import load_checkpoint
 
         engine = load_checkpoint(ckpt_dir)
+        from .store.checkpoint import engine_mode
+
+        mode = engine_mode(engine)
+        want = "byzantine" if args.byzantine else "fused"
+        if (mode == "byzantine") != (want == "byzantine"):
+            raise SystemExit(
+                f"checkpoint {ckpt_dir} engine kind '{mode}' does not "
+                f"match --byzantine={bool(args.byzantine)}"
+            )
+        if mode == "byzantine":
+            caps = _parse_fork_caps(getattr(args, "fork_caps", ""))
+            if caps:
+                # the checkpoint carries no capacity hints: re-apply the
+                # pre-sizing or every resume pays the growth re-jits
+                engine.pre_size(caps)
+        n_ev = (len(engine.dag.events) if mode == "byzantine"
+                else engine.dag.n_events)
         print(f"resumed from checkpoint {ckpt_dir}: "
-              f"{engine.dag.n_events} events, "
+              f"{n_ev} events, "
               f"{engine.consensus_events_count()} in consensus order")
 
     conf = Config(
@@ -81,6 +117,7 @@ async def _run_node(args) -> int:
         seq_window=args.seq_window or None,
         byzantine=args.byzantine,
         fork_k=args.fork_k,
+        fork_caps=_parse_fork_caps(getattr(args, "fork_caps", "")),
     )
     conf.logger.setLevel(args.log_level.upper())
 
@@ -132,11 +169,6 @@ async def _checkpoint_loop(node, ckpt_dir: str, interval: float) -> None:
 
 
 def cmd_run(args) -> int:
-    if getattr(args, "byzantine", False) and args.checkpoint_dir:
-        raise SystemExit(
-            "--byzantine has no checkpoint path; drop --checkpoint_dir "
-            "(README: Byzantine mode scope)"
-        )
     try:
         return asyncio.run(_run_node(args))
     except KeyboardInterrupt:
@@ -368,6 +400,10 @@ def main(argv=None) -> int:
                          "equivocations instead of rejecting them")
     rn.add_argument("--fork_k", type=int, default=2,
                     help="branch slots per creator (fork budget K-1)")
+    rn.add_argument("--fork_caps", default="",
+                    help="pre-sized byzantine pipeline capacities "
+                         "'e,s,r' (one jit shape at boot instead of "
+                         "demand-driven growth recompiles)")
     rn.add_argument("--seq_window", type=int, default=0,
                     help="per-creator rolling window (0 = cache_size)")
     rn.add_argument("--jax_cache", default="",

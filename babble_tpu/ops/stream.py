@@ -63,6 +63,8 @@ from .state import (
 from .wide import (
     MarchCarry,
     _init_blocks,
+    _init_blocks_stacked,
+    _is_stacked,
     _jits,
     block_count,
     run_wide_coords,
@@ -83,14 +85,27 @@ class WideStream:
 
     def __init__(self, cfg: DagConfig, n_blocks: Optional[int] = None,
                  round_margin: int = 0, seq_window: int = 64,
-                 record_ordered: bool = True):
+                 record_ordered: bool = True, stacked: bool = False,
+                 mesh=None):
+        """``stacked=True`` holds la/fd as one [C, E+1, w] array driven
+        by the vmapped stacked kernels; with ``mesh`` (an axis named
+        "p") the block axis is sharded across devices and the cross-
+        block reductions become XLA collectives — the p-sharded window
+        composition the v5e-8 north star needs (blocks are the single-
+        chip stand-in for p-shards, ops/wide.py docstring)."""
         self.cfg = cfg
         self.C = n_blocks or block_count(cfg)
         self.round_margin = round_margin
         self.seq_window = seq_window
         self.record_ordered = record_ordered
+        self.mesh = mesh
         self.state: DagState = init_state(cfg, include_coords=False)
-        self.la_blocks, self.fd_blocks = _init_blocks(cfg, self.C)
+        if stacked or mesh is not None:
+            self.la_blocks, self.fd_blocks = _init_blocks_stacked(
+                cfg, self.C, mesh
+            )
+        else:
+            self.la_blocks, self.fd_blocks = _init_blocks(cfg, self.C)
         self.carry: Optional[MarchCarry] = None
         self.e_off = 0                  # host mirror (global slot of row 0)
         self.lcr = -1                   # host mirror after last consensus
@@ -129,7 +144,7 @@ class WideStream:
             self.C, fd_slot_sched=fd_slot_sched,
         )
         _ = np.asarray(self.state.n_events)
-        jax.block_until_ready(self.la_blocks + self.fd_blocks)
+        jax.block_until_ready((self.la_blocks, self.fd_blocks))
         self._tick("coords", t0)
 
     def consensus(self, final: bool = False) -> int:
@@ -255,16 +270,25 @@ class WideStream:
             jnp.concatenate([ds, jnp.zeros((C * w - n,), I32)])
             if C * w > n else ds
         )
-        self.la_blocks = tuple(
-            j["compact_block"](self.la_blocks[c], de,
-                               ds_pad[c * w:(c + 1) * w], False)
-            for c in range(C)
-        )
-        self.fd_blocks = tuple(
-            j["compact_block"](self.fd_blocks[c], de,
-                               ds_pad[c * w:(c + 1) * w], True)
-            for c in range(C)
-        )
+        if _is_stacked(self.la_blocks):
+            ds_stack = ds_pad.reshape(C, w)
+            self.la_blocks = j["compact_stacked"](
+                self.la_blocks, de, ds_stack, False
+            )
+            self.fd_blocks = j["compact_stacked"](
+                self.fd_blocks, de, ds_stack, True
+            )
+        else:
+            self.la_blocks = tuple(
+                j["compact_block"](self.la_blocks[c], de,
+                                   ds_pad[c * w:(c + 1) * w], False)
+                for c in range(C)
+            )
+            self.fd_blocks = tuple(
+                j["compact_block"](self.fd_blocks[c], de,
+                                   ds_pad[c * w:(c + 1) * w], True)
+                for c in range(C)
+            )
         if self.carry is not None:
             pt, cp = j["compact_march"](
                 self.carry.pos_table, self.carry.cnt_prev,
@@ -341,12 +365,21 @@ def stream_consensus(
     compact_min: int = 1024,
     record_ordered: bool = True,
     log=None,
+    stacked: bool = False,
+    mesh=None,
+    deadline_s: Optional[float] = None,
 ) -> WideStream:
     """Stream an ArrayDag (sim.arrays) through a rolling window:
-    ingest -> consensus -> compact per mega-batch of ~batch_events."""
+    ingest -> consensus -> compact per mega-batch of ~batch_events.
+
+    ``deadline_s`` (wall seconds from call): stop cleanly after the
+    current batch when exceeded, marking ``stats["truncated"]`` —
+    partial ordering evidence beats a watchdog kill with none (the
+    bench's budget contract)."""
     stream = WideStream(cfg, n_blocks=n_blocks,
                         round_margin=round_margin, seq_window=seq_window,
-                        record_ordered=record_ordered)
+                        record_ordered=record_ordered, stacked=stacked,
+                        mesh=mesh)
     E = dag.n_events
     # suffix-min of parent slots: the eviction bound for "no future
     # batch references below here"
@@ -360,10 +393,19 @@ def stream_consensus(
     head_seqs = np.full(cfg.n, -1, np.int64)
     np.maximum.at(head_seqs, dag.creator, dag.seq)
 
+    t_start = time.perf_counter()
     s_off_np = np.zeros(cfg.n, np.int64)
     a = 0
     bi = 0
     while a < E:
+        if (deadline_s is not None and bi > 0
+                and time.perf_counter() - t_start > deadline_s):
+            stream.stats["truncated"] = True
+            stream.stats["events_ingested"] = a
+            if log is not None:
+                log(f"[stream] deadline {deadline_s:.0f}s hit after "
+                    f"{bi} batches ({a}/{E} events) — stopping cleanly")
+            break
         b = min(E, a + batch_events)
         batch = slice_batch(dag, a, b, stream.e_off)
         # in-window chain depth must fit the ce table: the scatter in

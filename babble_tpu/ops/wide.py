@@ -365,7 +365,22 @@ def _jits(cfg: DagConfig, C: int):
         monotone along a chain, so round i's witness set is FINAL iff
         every chain's head round >= i.  Mid-stream fame gates decisions
         on this (ops/stream.py), which makes streaming scheduling-
-        invariant and bit-identical to the whole-DAG batch."""
+        invariant and bit-identical to the whole-DAG batch.
+
+        Liveness assumption (ADVICE r4 low): never-minted chains map to
+        -1, so mid-stream fame (complete=False) decides nothing until
+        every one of the N participants has minted at least one event —
+        and a chain that stops minting forever freezes the head-round
+        minimum, deferring all further decisions to the final full-DAG
+        pass (unbounded live window).  This is the same all-N liveness
+        the protocol itself has (a round's witness set needs every
+        creator to reach it; the reference advances LastConsensusRound
+        only when all witnesses of a round are decided).  A production
+        stream that must survive permanently-offline participants needs
+        an inactivity horizon that excludes stale chains from this
+        minimum — which changes the witness universe and is a consensus-
+        visible membership decision, not a local optimization; the
+        stream keeps the conservative protocol semantics instead."""
         cnt_w = state.cnt[:n] - state.s_off[:n]
         heads = state.ce[jnp.arange(n), jnp.clip(cnt_w - 1, 0, s_cap)]
         hr = state.round[sanitize(jnp.where(cnt_w > 0, heads, -1), e_cap)]
@@ -604,6 +619,77 @@ def _jits(cfg: DagConfig, C: int):
 
     newly_range = jax.jit(_newly_range)
 
+    # ---------------- stacked twins (sharded streaming) ----------------
+    # The same block kernels vmapped over a leading block axis
+    # [C, E+1, w]: one jitted program per phase step instead of C host
+    # dispatches, and — with the stacked blocks laid out P("p") over a
+    # device mesh (parallel/sharded.py wide-stream section) — XLA
+    # partitions each vmapped kernel per-device and turns the
+    # cross-block reductions (.sum(0) / .any(0) / reshape-concat) into
+    # ICI collectives.  ``offs`` is the per-block column origin,
+    # jnp.arange(C) * w.  Bit-parity with the tuple path is pinned by
+    # tests/test_stream.py and tests/test_parallel.py.
+
+    la_scan_stacked = jax.jit(
+        jax.vmap(_la_block_scan, in_axes=(None,) * 5 + (0, None, 0)),
+        donate_argnums=(5,),
+    )
+    fd_scan_stacked = jax.jit(
+        jax.vmap(_fd_block_scan, in_axes=(None,) * 8 + (0, None, 0)),
+        donate_argnums=(8,),
+    )
+    gather_stacked = jax.jit(jax.vmap(_gather_rows, in_axes=(0, None)))
+
+    def _ss_stacked(law, fdw):
+        z = jnp.zeros((law.shape[1], fdw.shape[1]), I32)
+        return jax.vmap(
+            lambda a, b: _ss_partial(a, b, z)
+        )(law, fdw).sum(0)
+
+    ss_stacked = jax.jit(_ss_stacked)
+
+    def _votes0_stacked(law, seqw_i, offs, valid_1, valid_i):
+        v = jax.vmap(_votes0_block, in_axes=(0, None, 0, None, None))(
+            law, seqw_i, offs, valid_1, valid_i
+        )
+        return jnp.swapaxes(v, 0, 1).reshape(v.shape[1], -1)[:, :n]
+
+    votes0_stacked = jax.jit(_votes0_stacked)
+
+    def _inherit_stacked(fde):
+        return jax.vmap(_inherit_block)(fde).reshape(-1)[:n]
+
+    inherit_stacked = jax.jit(_inherit_stacked)
+
+    def _sees_stacked(FD, seqw_i, fam_i, offs):
+        z = jnp.zeros((e_cap + 1,), I32)
+        return jax.vmap(
+            lambda blk, o: _sees_partial_block(blk, seqw_i, fam_i, o, z)
+        )(FD, offs).sum(0)
+
+    sees_stacked = jax.jit(_sees_stacked)
+
+    def _med_tv_stacked(state, FD_rows, i_rows, seqw, fam, offs, tmin,
+                        scale, rel32):
+        tv, cnt, bad = jax.vmap(
+            _med_tv_block,
+            in_axes=(None, 0, None, None, None, 0, None, None, None),
+        )(state, FD_rows, i_rows, seqw, fam, offs, tmin, scale, rel32)
+        tvf = jnp.swapaxes(tv, 0, 1).reshape(tv.shape[1], -1)[:, :n]
+        return tvf, cnt.sum(0), bad.any(0)
+
+    med_tv_stacked = jax.jit(_med_tv_stacked, static_argnums=(8,))
+
+    def _slice_stacked(A, e0, rows):
+        return jax.lax.dynamic_slice_in_dim(A, e0, rows, 1)
+
+    slice_stacked = jax.jit(_slice_stacked, static_argnums=(2,))
+
+    compact_stacked = jax.jit(
+        jax.vmap(_compact_block, in_axes=(0, None, 0, None)),
+        static_argnums=(3,), donate_argnums=(0,),
+    )
+
     return dict(
         write_batch=write_batch, la_block_scan=la_block_scan,
         fd_block_scan=fd_block_scan, coord_sent=coord_sent,
@@ -624,6 +710,11 @@ def _jits(cfg: DagConfig, C: int):
         write_rows=write_rows, med_chunk=med_chunk, width=w,
         compact_block=compact_block, compact_march=compact_march,
         newly_range=newly_range,
+        la_scan_stacked=la_scan_stacked, fd_scan_stacked=fd_scan_stacked,
+        gather_stacked=gather_stacked, ss_stacked=ss_stacked,
+        votes0_stacked=votes0_stacked, inherit_stacked=inherit_stacked,
+        sees_stacked=sees_stacked, med_tv_stacked=med_tv_stacked,
+        slice_stacked=slice_stacked, compact_stacked=compact_stacked,
     )
 
 
@@ -647,6 +738,51 @@ def _init_blocks(cfg: DagConfig, C: int):
         jnp.full((e1, w), cfg.fd_inf, cfg.coord_dtype) for _ in range(C)
     )
     return la, fd
+
+
+def _init_blocks_stacked(cfg: DagConfig, C: int, mesh=None):
+    """Stacked block arrays [C, E+1, w]; with ``mesh`` they are placed
+    P("p", None, None) so each device owns C/p blocks and the stacked
+    kernels run SPMD with XLA-inserted collectives."""
+    w = _block_width(cfg, C)
+    e1 = cfg.e_cap + 1
+    la = jnp.full((C, e1, w), -1, cfg.coord_dtype)
+    fd = jnp.full((C, e1, w), cfg.fd_inf, cfg.coord_dtype)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if C % mesh.shape["p"]:
+            raise ValueError(
+                f"block count C={C} must be a multiple of mesh "
+                f"'p'={mesh.shape['p']}"
+            )
+        sh = NamedSharding(mesh, P("p", None, None))
+        la, fd = jax.device_put(la, sh), jax.device_put(fd, sh)
+    return la, fd
+
+
+def _is_stacked(blocks) -> bool:
+    return not isinstance(blocks, (tuple, list))
+
+
+def _block_offs(C: int, w: int):
+    return jnp.arange(C, dtype=I32) * w
+
+
+def _gather_all(j, C, blocks, idx):
+    """Rows of every block for slot indices idx: stacked [C, A, w] or a
+    list of C [A, w] arrays."""
+    if _is_stacked(blocks):
+        return j["gather_stacked"](blocks, idx)
+    return [j["gather_rows"](blocks[c], idx) for c in range(C)]
+
+
+def _ss_all(j, C, w, law, fdw, n):
+    """Full strongly-see counts from per-block gathered rows."""
+    if _is_stacked(law):
+        return j["ss_stacked"](law, fdw)
+    return _blocked_ss(j, C, w, law, fdw, n)
 
 
 def _split_blocks(cfg: DagConfig, C: int, full: jnp.ndarray, fill):
@@ -696,17 +832,26 @@ def run_wide_coords(cfg: DagConfig, state: DagState, batch: EventBatch,
     w = j["width"]
     sp, op, creator, seq = state.sp, state.op, state.creator, state.seq
     s_off = state.s_off
-    la_blocks = tuple(
-        j["la_block_scan"](sp, op, creator, seq, s_off, la_blocks[c],
-                           slot_sched, jnp.asarray(c * w, I32))
-        for c in range(C)
-    )
-    fd_blocks = tuple(
-        j["fd_block_scan"](sp, op, creator, seq, s_off, batch.seq,
-                           batch.k, state.n_events, fd_blocks[c],
-                           fd_slot_sched, jnp.asarray(c * w, I32))
-        for c in range(C)
-    )
+    if _is_stacked(la_blocks):
+        offs = _block_offs(C, w)
+        la_blocks = j["la_scan_stacked"](sp, op, creator, seq, s_off,
+                                         la_blocks, slot_sched, offs)
+        fd_blocks = j["fd_scan_stacked"](sp, op, creator, seq, s_off,
+                                         batch.seq, batch.k,
+                                         state.n_events, fd_blocks,
+                                         fd_slot_sched, offs)
+    else:
+        la_blocks = tuple(
+            j["la_block_scan"](sp, op, creator, seq, s_off, la_blocks[c],
+                               slot_sched, jnp.asarray(c * w, I32))
+            for c in range(C)
+        )
+        fd_blocks = tuple(
+            j["fd_block_scan"](sp, op, creator, seq, s_off, batch.seq,
+                               batch.k, state.n_events, fd_blocks[c],
+                               fd_slot_sched, jnp.asarray(c * w, I32))
+            for c in range(C)
+        )
     state = j["coord_sent"](state)
     return state, la_blocks, fd_blocks
 
@@ -759,13 +904,13 @@ def run_wide_rounds(cfg: DagConfig, state: DagState, la_blocks,
             pos, pos_table[r + 1], cnt, cnt_prev
         )
         ws, valid_w = j["round_witnesses"](state, cnt, pos)
-        fdw = [j["gather_rows"](fd_blocks[c], ws) for c in range(C)]
+        fdw = _gather_all(j, C, fd_blocks, ws)
 
         bisect_iters = max(1, int(span).bit_length())
         for _ in range(bisect_iters):
             mid, xs = j["bisect_candidates"](state, lo, hi)
-            law = [j["gather_rows"](la_blocks[c], xs) for c in range(C)]
-            cnt_ab = _blocked_ss(j, C, w, law, fdw, n)
+            law = _gather_all(j, C, la_blocks, xs)
+            cnt_ab = _ss_all(j, C, w, law, fdw, n)
             lo, hi = j["bisect_update"](cnt_ab, valid_w, lo, hi, mid,
                                         cnt)
         if stats is not None:
@@ -776,11 +921,16 @@ def run_wide_rounds(cfg: DagConfig, state: DagState, la_blocks,
         # descent inheritance via the first-inc events' fd rows
         _, e_star = j["bisect_candidates"](state, s_star, s_star)
         e_star = jnp.where(found, e_star, -1)
-        inh = [
-            j["inherit_block"](j["gather_rows"](fd_blocks[c], e_star))
-            for c in range(C)
-        ]
-        inherit = jnp.concatenate(inh)[:n]
+        if _is_stacked(fd_blocks):
+            inherit = j["inherit_stacked"](
+                j["gather_stacked"](fd_blocks, e_star)
+            )
+        else:
+            inh = [
+                j["inherit_block"](j["gather_rows"](fd_blocks[c], e_star))
+                for c in range(C)
+            ]
+            inherit = jnp.concatenate(inh)[:n]
         pos, pos_table, any_next = j["frontier_next"](
             cnt, pos, pos_table, jnp.asarray(r, I32), s_star, found,
             inherit, frozen, pos_table[r + 1],
@@ -815,6 +965,7 @@ def run_wide_fame(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
     j = _jits(cfg, C)
     w = j["width"]
     n = cfg.n
+    offs = _block_offs(C, w) if _is_stacked(la_blocks) else None
     lcr = int(state.lcr)
     max_round = int(state.max_round)
     r_off = int(state.r_off)
@@ -831,16 +982,22 @@ def run_wide_fame(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
         famous_i = famous[i]
 
         ws_1, valid_1 = j["fame_wits"](state, jnp.asarray(i + 1, I32))
-        votes = jnp.concatenate(
-            [
-                j["votes0_block"](
-                    j["gather_rows"](la_blocks[c], ws_1), seqw_i,
-                    jnp.asarray(c * w, I32), valid_1, valid_i,
-                )
-                for c in range(C)
-            ],
-            axis=1,
-        )[:, :n]
+        if _is_stacked(la_blocks):
+            votes = j["votes0_stacked"](
+                j["gather_stacked"](la_blocks, ws_1), seqw_i,
+                offs, valid_1, valid_i,
+            )
+        else:
+            votes = jnp.concatenate(
+                [
+                    j["votes0_block"](
+                        j["gather_rows"](la_blocks[c], ws_1), seqw_i,
+                        jnp.asarray(c * w, I32), valid_1, valid_i,
+                    )
+                    for c in range(C)
+                ],
+                axis=1,
+            )[:, :n]
 
         und_any = bool(((np.asarray(famous_i) == fame_ops.FAME_UNDEFINED)
                         & np.asarray(valid_i)).any())
@@ -850,11 +1007,9 @@ def run_wide_fame(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
                                            jnp.asarray(i + d, I32))
             ws_p, valid_p = j["fame_wits"](state,
                                            jnp.asarray(i + d - 1, I32))
-            law = [j["gather_rows"](la_blocks[c], ws_j)
-                   for c in range(C)]
-            fdw = [j["gather_rows"](fd_blocks[c], ws_p)
-                   for c in range(C)]
-            cnt_ab = _blocked_ss(j, C, w, law, fdw, n)
+            law = _gather_all(j, C, la_blocks, ws_j)
+            fdw = _gather_all(j, C, fd_blocks, ws_p)
+            cnt_ab = _ss_all(j, C, w, law, fdw, n)
             mb_j = state.mbit[sanitize(ws_j, cfg.e_cap)]
             votes, famous_i, und = j["fame_tally"](
                 cnt_ab, valid_j, valid_p, valid_i, votes, famous_i,
@@ -901,13 +1056,18 @@ def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
     seqw, fam, decided, has_w, fam_cnt, und = j["order_prep"](state)
 
     rr = state.rr
+    stacked = _is_stacked(fd_blocks)
+    offs = _block_offs(C, w) if stacked else None
     for i in range(lo_r, hi_r):
-        c = jnp.zeros((e1,), I32)
-        for blk in range(C):
-            c = j["sees_partial_block"](
-                fd_blocks[blk], seqw[i], fam[i],
-                jnp.asarray(blk * w, I32), c,
-            )
+        if stacked:
+            c = j["sees_stacked"](fd_blocks, seqw[i], fam[i], offs)
+        else:
+            c = jnp.zeros((e1,), I32)
+            for blk in range(C):
+                c = j["sees_partial_block"](
+                    fd_blocks[blk], seqw[i], fam[i],
+                    jnp.asarray(blk * w, I32), c,
+                )
         rr = j["order_rr_update"](state, und, decided[i], has_w[i],
                                   fam_cnt[i], jnp.asarray(i, I32), c, rr)
     newly = und & (rr != -1)
@@ -940,18 +1100,26 @@ def run_wide_order(cfg: DagConfig, state: DagState, la_blocks, fd_blocks,
         e0j = jnp.asarray(e0, I32)
         i_rows = j["slice_rows"](i_of, e0j, chunk)
         new_rows = j["slice_rows"](newly, e0j, chunk)
-        tvs, cnts = [], []
-        for blk in range(C):
-            fd_rows = j["slice_rows"](fd_blocks[blk], e0j, chunk)
-            tv_b, cnt_b, bad_b = j["med_tv_block"](
-                state, fd_rows, i_rows, seqw, fam,
-                jnp.asarray(blk * w, I32), tmin, scale_j, rel32,
+        if stacked:
+            fd_rows = j["slice_stacked"](fd_blocks, e0j, chunk)
+            tv_full, cnt_s, bad_rows = j["med_tv_stacked"](
+                state, fd_rows, i_rows, seqw, fam, offs, tmin,
+                scale_j, rel32,
             )
-            tvs.append(tv_b)
-            cnts.append(cnt_b)
-            bad_total = bad_total + (bad_b & new_rows).sum(dtype=I32)
-        tv_full = jnp.concatenate(tvs, axis=1)[:, :n]
-        cnt_s = sum(cnts[1:], cnts[0])
+            bad_total = bad_total + (bad_rows & new_rows).sum(dtype=I32)
+        else:
+            tvs, cnts = [], []
+            for blk in range(C):
+                fd_rows = j["slice_rows"](fd_blocks[blk], e0j, chunk)
+                tv_b, cnt_b, bad_b = j["med_tv_block"](
+                    state, fd_rows, i_rows, seqw, fam,
+                    jnp.asarray(blk * w, I32), tmin, scale_j, rel32,
+                )
+                tvs.append(tv_b)
+                cnts.append(cnt_b)
+                bad_total = bad_total + (bad_b & new_rows).sum(dtype=I32)
+            tv_full = jnp.concatenate(tvs, axis=1)[:, :n]
+            cnt_s = sum(cnts[1:], cnts[0])
         cts_rows = j["slice_rows"](cts, e0j, chunk)
         upd = j["med_reduce"](tv_full, cnt_s, new_rows, cts_rows, tmin,
                               scale_j, rel32)

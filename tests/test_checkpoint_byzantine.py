@@ -1,0 +1,225 @@
+"""Byzantine checkpoint/resume tests (VERDICT r4 missing #5): the
+fork-aware engine persists through the same atomic-checkpoint layout as
+the honest one — ForkDag host state (window events, branch columns,
+divergence points, round/witness seeds) is the whole state; device
+tensors are rebuilt from it on every run.
+
+Invariants:
+- save -> load reproduces the predicate surface, fork-detection state
+  and consensus log;
+- a resumed WINDOWED engine continues ingesting + ordering identically
+  to one that never stopped (crash recovery under equivocation);
+- the fast-forward snapshot path applies the same hostile-meta checks
+  as the honest path (structural bounds before object construction).
+"""
+
+import msgpack
+import pytest
+
+from babble_tpu.consensus.fork_engine import ForkHashgraph
+from babble_tpu.sim import random_byzantine_dag
+from babble_tpu.store import load_checkpoint, save_checkpoint
+from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+
+def _build(n=6, n_events=400, seed=13, **kw):
+    dag = random_byzantine_dag(n, n_events, seed=seed, fork_rate=0.05)
+    eng = ForkHashgraph(dag.participants, k=2, **kw)
+    return dag, eng
+
+
+def test_fork_checkpoint_roundtrip(tmp_path):
+    dag, eng = _build()
+    half = len(dag.events) // 2
+    for ev in dag.events[:half]:
+        eng.insert_event(ev)
+    eng.run_consensus()
+
+    ckpt = str(tmp_path / "fork_ckpt")
+    save_checkpoint(eng, ckpt)
+    restored = load_checkpoint(ckpt)
+
+    assert isinstance(restored, ForkHashgraph)
+    assert restored.consensus_events() == eng.consensus_events()
+    assert restored.known() == eng.known()
+    assert restored._lcr_cache == eng._lcr_cache
+    assert restored.dag.br_used == eng.dag.br_used
+    assert restored.dag.br_div == eng.dag.br_div
+    assert restored.max_round() == eng.max_round()
+    # predicate surface on live events, incl. fork detection
+    for s in range(0, len(eng.dag.events), 37):
+        x = eng.dag.events[s].hex()
+        assert restored.round(x) == eng.round(x)
+        assert restored.witness(x) == eng.witness(x)
+        for cid in range(eng.n):
+            assert restored.detects_fork(x, cid) == eng.detects_fork(x, cid)
+
+
+def test_fork_windowed_resume_continues_identically(tmp_path):
+    """Crash-recovery under equivocation WITH a rolling window: the
+    resumed engine must keep committing the same order as one that
+    never stopped, across further evictions on both sides."""
+    dag, eng = _build(n_events=600, seed=11, auto_compact=True,
+                      round_margin=1, seq_window=6, compact_min=16)
+    half = len(dag.events) // 2
+    committed = []
+    for ev in dag.events[:half]:
+        eng.insert_event(ev)
+    committed += [(e.hex(), e.round_received) for e in eng.run_consensus()]
+
+    ckpt = str(tmp_path / "fork_ckpt")
+    save_checkpoint(eng, ckpt)
+    resumed = load_checkpoint(ckpt)
+    committed_resumed = list(committed)
+    assert resumed.dag.evicted == eng.dag.evicted
+
+    chunk = 60
+    for i in range(half, len(dag.events), chunk):
+        for ev in dag.events[i:i + chunk]:
+            eng.insert_event(ev.clone())
+            resumed.insert_event(ev.clone())
+        committed += [
+            (e.hex(), e.round_received) for e in eng.run_consensus()
+        ]
+        committed_resumed += [
+            (e.hex(), e.round_received) for e in resumed.run_consensus()
+        ]
+
+    assert len(committed) > len(dag.events) // 4
+    assert committed_resumed == committed
+    assert resumed._lcr_cache == eng._lcr_cache
+    assert resumed.known() == eng.known()
+    assert eng.dag.evicted > 0, "window never rolled"
+
+
+def test_fork_core_resumes_head(tmp_path):
+    """A restarted byzantine node continues its own chain instead of
+    equivocating against itself."""
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.node import Core
+
+    keys = sorted([generate_key() for _ in range(3)],
+                  key=lambda k: k.pub_hex)
+    participants = {k.pub_hex: i for i, k in enumerate(keys)}
+    cores = [
+        Core(i, keys[i], participants, byzantine=True, fork_k=2)
+        for i in range(3)
+    ]
+    for c in cores:
+        c.init()
+    diff = cores[0].diff(cores[1].known())
+    cores[1].sync(cores[0].head, cores[0].to_wire(diff), [b"tx"])
+
+    ckpt = str(tmp_path / "fork_core_ckpt")
+    save_checkpoint(cores[1].hg, ckpt)
+    engine = load_checkpoint(ckpt)
+    resumed = Core(1, keys[1], participants, engine=engine)
+    assert resumed.byzantine
+    assert resumed.head == cores[1].head
+    assert resumed.seq == cores[1].seq
+    resumed.add_self_event([b"after-restart"])
+    assert resumed.seq == cores[1].seq + 1
+
+
+def test_fork_snapshot_hostile_meta_rejected():
+    """The byzantine fast-forward payload gets the same pre-construction
+    hardening as the honest one: membership, window bound, and slot-
+    reference ranges are validated on the declared meta before any
+    Event object or branch index is built."""
+    dag, eng = _build(n=5, n_events=120)
+    for ev in dag.events:
+        eng.insert_event(ev)
+    eng.run_consensus()
+    snap = snapshot_bytes(eng)
+
+    restored = load_snapshot(
+        snap, verify_events=False,
+        expected_participants=eng.participants,
+        max_caps=(1 << 22, 1 << 20, 1 << 16),
+    )
+    assert restored.known() == eng.known()
+
+    # foreign membership rejected
+    other = dict(eng.participants)
+    first = next(iter(other))
+    other[first + "ff"] = other.pop(first)
+    with pytest.raises(ValueError, match="participant set"):
+        load_snapshot(snap, verify_events=False,
+                      expected_participants=other)
+
+    meta_b, npz_b = msgpack.unpackb(snap, raw=False)
+    meta = msgpack.unpackb(meta_b, raw=False, strict_map_key=False)
+
+    # window beyond our memory bound rejected before any event unpacks
+    with pytest.raises(ValueError, match="exceeds bound"):
+        load_snapshot(snap, verify_events=False,
+                      max_caps=(16, 1 << 20, 1 << 16))
+
+    # out-of-range slot references rejected (corrupt/hostile index)
+    lied = dict(meta)
+    lied["sp_slot"] = list(meta["sp_slot"])
+    lied["sp_slot"][-1] = len(meta["events"]) + 7
+    hostile = msgpack.packb(
+        [msgpack.packb(lied, use_bin_type=True), npz_b], use_bin_type=True
+    )
+    with pytest.raises(ValueError, match="out of range"):
+        load_snapshot(hostile, verify_events=False)
+
+    # absurd fork budget rejected
+    lied2 = dict(meta)
+    lied2["k"] = 500
+    hostile2 = msgpack.packb(
+        [msgpack.packb(lied2, use_bin_type=True), npz_b], use_bin_type=True
+    )
+    with pytest.raises(ValueError, match="fork budget"):
+        load_snapshot(hostile2, verify_events=False)
+
+
+def test_fork_bootstrap_refuses_snapshot_forking_us(tmp_path):
+    """A snapshot that records an equivocation by OUR key must be
+    refused: adopting it (or replaying our tail onto a diverged view of
+    our chain) would publish a fork under our signature."""
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.node import Core
+
+    keys = sorted([generate_key() for _ in range(3)],
+                  key=lambda k: k.pub_hex)
+    participants = {k.pub_hex: i for i, k in enumerate(keys)}
+    cores = [
+        Core(i, keys[i], participants, byzantine=True, fork_k=2)
+        for i in range(3)
+    ]
+    for c in cores:
+        c.init()
+    # core 0 equivocates: two index-1 events on top of its root
+    from babble_tpu.core.event import new_event
+
+    roots = {
+        i: cores[i].hg.dag.events[cores[i].hg.dag.cr_events[i][0]]
+        for i in range(3)
+    }
+    # core 1 learns everyone's root first
+    for i in (0, 2):
+        cores[1].insert_event(roots[i].clone())
+    root0 = roots[0]
+    a = new_event([b"a"], (root0.hex(), cores[1].head),
+                  keys[0].pub_bytes, 1)
+    a.sign(keys[0])
+    b = new_event([b"b"], (root0.hex(), roots[2].hex()),
+                  keys[0].pub_bytes, 1)
+    b.sign(keys[0])
+    # core 1 sees both branches of core 0's fork
+    for ev in (a, b):
+        cores[1].insert_event(ev)
+    snap = snapshot_bytes(cores[1].hg)
+
+    # core 0 (the equivocator's key) must refuse to bootstrap from it
+    engine = load_snapshot(snap, verify_events=True,
+                           expected_participants=participants)
+    with pytest.raises(ValueError, match="our own key"):
+        cores[0].bootstrap(engine)
+    # core 2 (honest bystander) adopts it fine
+    engine2 = load_snapshot(snap, verify_events=True,
+                            expected_participants=participants)
+    cores[2].bootstrap(engine2)
+    assert cores[2].head  # still has a live head afterwards

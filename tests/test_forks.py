@@ -247,3 +247,89 @@ def test_windowed_fork_engine_matches_unevicted():
         assert rolled.round(x) == plain.round(x), x
     # the gossip clock stays absolute across eviction
     assert rolled.known() == plain.known()
+
+
+def test_laggard_chains_block_unsafe_eviction():
+    """ADVICE r4 medium #1: lcr advances on a supermajority and can
+    outrun laggard chains.  Two creators that gossip only with each
+    other stay at low rounds while the fast group's lcr climbs; when
+    they finally merge back, their events legitimately get LOW rounds,
+    and assigning those needs the low-round witnesses of the fast
+    creators.  A windowed replica that evicted those witnesses would
+    compute different rounds than an unevicted one — consensus
+    divergence across differently-windowed replicas.  maybe_compact's
+    round-consistency gate (max evicted round < min retained round)
+    must keep the two engines bit-identical."""
+    import numpy as np
+
+    from babble_tpu.core.event import new_event
+
+    n, n_fast = 9, 7            # 7 >= 2*9//3 + 1: fast supermajority
+    rng = np.random.default_rng(5)
+
+    def fake_pub(i):
+        return b"\x04" + i.to_bytes(32, "big") + bytes(32)
+
+    participants = {("0x" + fake_pub(i).hex().upper()): i for i in range(n)}
+    pubs = [fake_pub(i) for i in range(n)]
+    heads, seqs = [None] * n, [0] * n
+    events = []
+    t = [0]
+
+    def mint(recv, send):
+        t[0] += 1
+        ts = 1_700_000_000_000_000_000 + t[0] * 2_000_000
+        parents = ("", "") if heads[recv] is None else (
+            heads[recv], heads[send])
+        ev = new_event([], parents, pubs[recv], seqs[recv], timestamp=ts)
+        ev.r = int(rng.integers(1, 1 << 62))
+        ev.s = int(rng.integers(1, 1 << 62))
+        events.append(ev)
+        heads[recv] = ev.hex()
+        seqs[recv] += 1
+
+    for i in range(n):
+        mint(i, i)              # roots
+    for step in range(700):
+        recv = int(rng.integers(0, n_fast))
+        send = int(rng.integers(0, n_fast - 1))
+        if send >= recv:
+            send += 1
+        mint(recv, send)        # fast group gossips among itself
+        if step % 60 == 30:
+            mint(7, 8)          # laggards whisper to each other only
+        if step % 60 == 45:
+            mint(8, 7)
+    mint(7, 8)                  # the late laggard merge (low round)
+    mint(0, 7)                  # fast group finally hears the laggards
+    for _ in range(60):
+        recv = int(rng.integers(0, n_fast))
+        send = int(rng.integers(0, n_fast - 1))
+        if send >= recv:
+            send += 1
+        mint(recv, send)
+
+    plain = ForkHashgraph(participants, k=2)
+    rolled = ForkHashgraph(participants, k=2, auto_compact=True,
+                           round_margin=1, seq_window=4, compact_min=8)
+    committed_plain, committed_rolled = [], []
+    chunk = 80
+    for i in range(0, len(events), chunk):
+        for ev in events[i:i + chunk]:
+            plain.insert_event(ev)
+            rolled.insert_event(rolled.read_wire_info(plain.to_wire(ev)))
+        committed_plain += [
+            (e.hex(), e.round_received) for e in plain.run_consensus()
+        ]
+        committed_rolled += [
+            (e.hex(), e.round_received) for e in rolled.run_consensus()
+        ]
+
+    assert plain.max_round() >= 4, "fast group never outran the laggards"
+    assert committed_rolled == committed_plain
+    assert rolled._lcr_cache == plain._lcr_cache
+    # every live event's round matches the unevicted engine — including
+    # the late merge events whose rounds sit far below lcr
+    for s in range(len(rolled.dag.events)):
+        x = rolled.dag.events[s].hex()
+        assert rolled.round(x) == plain.round(x), x

@@ -113,3 +113,31 @@ def test_stream_rejects_window_overflow():
     cfg = DagConfig(n=n, e_cap=128, s_cap=64, r_cap=16)
     with pytest.raises(ValueError, match="overflow|depth"):
         stream_consensus(cfg, dag, batch_events=200, compact_min=10**9)
+
+
+def test_stream_stacked_sharded_parity():
+    """VERDICT r4 item 3: the stacked block path (one vmapped program
+    per phase instead of C host dispatches) and its p-sharded form over
+    a real ("ev","p") mesh must stay bit-identical to the fused
+    pipeline — the window x p-shards composition the v5e-8 north star
+    needs.  The blocks ride mesh axis "p"; cross-block strongly-see /
+    sees / median reductions become XLA collectives."""
+    from babble_tpu.parallel.mesh import make_mesh
+
+    n, e = 24, 2800
+    dag = random_gossip_arrays(n, e, seed=13)
+    _, out = _fused_reference(n, e, dag)
+    cfg = DagConfig(n=n, e_cap=1400, s_cap=110, r_cap=16)
+
+    stream = stream_consensus(cfg, dag, batch_events=350, n_blocks=4,
+                              round_margin=0, seq_window=16,
+                              compact_min=64, stacked=True)
+    assert stream.evicted > 0, "compaction never engaged (stacked)"
+    _assert_stream_matches(stream, out, e)
+
+    mesh = make_mesh(8, shape=(1, 8))
+    stream2 = stream_consensus(cfg, dag, batch_events=350, n_blocks=8,
+                               round_margin=0, seq_window=16,
+                               compact_min=64, mesh=mesh)
+    assert stream2.evicted > 0, "compaction never engaged (sharded)"
+    _assert_stream_matches(stream2, out, e)

@@ -1,0 +1,215 @@
+"""WideHashgraph (live windowed wide engine) tests — VERDICT r4 item 4.
+
+- bit-parity against the fused TpuHashgraph at a forced-blocked small
+  shape: identical committed order, round_received and consensus
+  timestamps, with the wide engine rolling its window (evictions > 0)
+  while the fused reference keeps everything;
+- a live Node fleet (inmem transport, real asyncio gossip) running the
+  wide engine end to end: commits flow, prefixes agree, the window
+  rolls — the seq_window contract standing in for the stream driver's
+  generator-oracle eviction bounds (ops/stream.py docstring).
+"""
+
+import asyncio
+
+import pytest
+
+from babble_tpu.consensus.engine import TpuHashgraph
+from babble_tpu.consensus.wide_engine import WideHashgraph
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.net import InmemNetwork, Peer
+from babble_tpu.node import Config, Node
+from babble_tpu.proxy.inmem import InmemAppProxy
+from babble_tpu.sim.generator import random_gossip_dag
+
+
+def test_wide_engine_parity_with_fused():
+    """Same DAG, chunked identically: the windowed wide engine's
+    committed list must be a prefix of the fused engine's (the witness-
+    set finality gate defers, never diverges), with identical
+    (round_received, consensus_timestamp) per event."""
+    n = 8
+    dag = random_gossip_dag(n, 600, seed=21)
+    fused = TpuHashgraph(dag.participants, verify_signatures=False,
+                         e_cap=1024, s_cap=128, r_cap=64)
+    wide = WideHashgraph(dag.participants, verify_signatures=False,
+                         e_cap=384, s_cap=96, r_cap=32, n_blocks=2,
+                         auto_compact=True, seq_window=8,
+                         round_margin=1, compact_min=16)
+
+    committed_f, committed_w = [], []
+    chunk = 64
+    for i in range(0, len(dag.events), chunk):
+        for ev in dag.events[i:i + chunk]:
+            fused.insert_event(ev.clone())
+            wide.insert_event(ev.clone())
+        committed_f += [
+            (e.hex(), e.round_received, e.consensus_timestamp)
+            for e in fused.run_consensus()
+        ]
+        committed_w += [
+            (e.hex(), e.round_received, e.consensus_timestamp)
+            for e in wide.run_consensus()
+        ]
+        # mid-stream: wide must always be a prefix of fused
+        assert committed_w == committed_f[: len(committed_w)], (
+            f"diverged at chunk {i // chunk}"
+        )
+
+    assert len(committed_w) > len(dag.events) // 3, (
+        f"wide engine only committed {len(committed_w)} events"
+    )
+    assert committed_w == committed_f[: len(committed_w)]
+    assert wide.dag.slot_base > 0, "window never rolled"
+    assert wide.stream.evicted == wide.dag.slot_base
+    # the stats surface stays consistent with the fused engine's
+    sw, sf = wide.stats_snapshot(), fused.stats_snapshot()
+    assert sw["consensus_events"] == len(committed_w)
+    assert sf["consensus_events"] == len(committed_f)
+    assert sw["evicted_events"] > 0
+
+
+@pytest.mark.slow
+def test_wide_engine_live_node_fleet():
+    """The wide engine behind real Nodes over the inmem transport:
+    asyncio gossip + heartbeat, transactions committed everywhere in
+    the same order, window rolling under the live seq_window contract
+    (no generator oracle anywhere)."""
+    n_nodes, n_txs = 3, 6
+
+    async def go():
+        net = InmemNetwork()
+        keys = sorted([generate_key() for _ in range(n_nodes)],
+                      key=lambda k: k.pub_hex)
+        transports = [net.transport() for _ in range(n_nodes)]
+        peers = [
+            Peer(net_addr=t.local_addr(), pub_key_hex=k.pub_hex)
+            for t, k in zip(transports, keys)
+        ]
+        participants = {k.pub_hex: i for i, k in enumerate(keys)}
+        proxies = [InmemAppProxy() for _ in range(n_nodes)]
+        conf = Config.test_config(heartbeat=0.02)
+        conf.tcp_timeout = 5.0
+        conf.consensus_interval = 0.5
+        nodes = [
+            Node(conf, keys[i], peers, transports[i], proxies[i],
+                 engine=WideHashgraph(
+                     participants, verify_signatures=True,
+                     e_cap=512, s_cap=96, r_cap=32, n_blocks=2,
+                     auto_compact=True, seq_window=8, round_margin=1,
+                     compact_min=16,
+                 ))
+            for i in range(n_nodes)
+        ]
+        for nd in nodes:
+            nd.init()
+            nd.run_task(gossip=True)
+
+        for i in range(n_txs):
+            await proxies[i % n_nodes].submit_tx(f"tx{i}".encode())
+
+        async def all_committed():
+            while True:
+                if all(
+                    len(p.committed_transactions()) >= n_txs
+                    for p in proxies
+                ):
+                    return
+                await asyncio.sleep(0.05)
+
+        try:
+            # first consensus ticks compile the blocked pipeline on the
+            # CPU test backend — generous budget, like the byzantine
+            # fleet test
+            await asyncio.wait_for(all_committed(), 240)
+            lists = [nd.core.hg.consensus_events() for nd in nodes]
+            m = min(len(x) for x in lists)
+            assert m > 0
+            for x in lists[1:]:
+                assert x[:m] == lists[0][:m], "consensus order diverged"
+            # the rolling window is live on at least one node by now
+            assert any(
+                nd.core.hg.dag.slot_base > 0 for nd in nodes
+            ) or all(
+                nd.core.hg.dag.n_events < 128 for nd in nodes
+            )
+        finally:
+            for nd in nodes:
+                await nd.shutdown()
+
+    asyncio.run(go())
+
+
+def test_wide_engine_checkpoint_roundtrip_and_resume(tmp_path):
+    """Checkpoint/resume for the wide engine: the blocked la/fd hold
+    ancestry summaries learned from evicted events, so they are saved
+    state, not a rebuildable cache — a restored engine must continue
+    committing identically to one that never stopped."""
+    from babble_tpu.store import engine_mode, load_checkpoint, save_checkpoint
+
+    n = 8
+    dag = random_gossip_dag(n, 600, seed=21)
+    eng = WideHashgraph(dag.participants, verify_signatures=False,
+                        e_cap=384, s_cap=96, r_cap=32, n_blocks=2,
+                        auto_compact=True, seq_window=8,
+                        round_margin=1, compact_min=16)
+    half = len(dag.events) // 2
+    committed = []
+    chunk = 64
+    for i in range(0, half, chunk):
+        for ev in dag.events[i:min(i + chunk, half)]:
+            eng.insert_event(ev.clone())
+        committed += [
+            (e.hex(), e.round_received) for e in eng.run_consensus()
+        ]
+    assert eng.dag.slot_base > 0, "window never rolled before checkpoint"
+
+    ckpt = str(tmp_path / "wide_ckpt")
+    save_checkpoint(eng, ckpt)
+    resumed = load_checkpoint(ckpt)
+    assert engine_mode(resumed) == "wide"
+    assert resumed.known() == eng.known()
+    assert resumed.consensus_events() == eng.consensus_events()
+    assert resumed.stream.evicted == eng.stream.evicted
+    committed_resumed = list(committed)
+
+    for i in range(half, len(dag.events), chunk):
+        for ev in dag.events[i:i + chunk]:
+            eng.insert_event(ev.clone())
+            resumed.insert_event(ev.clone())
+        committed += [
+            (e.hex(), e.round_received) for e in eng.run_consensus()
+        ]
+        committed_resumed += [
+            (e.hex(), e.round_received) for e in resumed.run_consensus()
+        ]
+    assert len(committed) > len(dag.events) // 3
+    assert committed_resumed == committed
+    assert resumed.known() == eng.known()
+
+
+def test_wide_engine_fast_forward_snapshot_roundtrip():
+    """The wide engine serves and loads fast-forward snapshots (the
+    rolling-cache rejoin path): bytes -> engine with the same window,
+    log and blocks, under local policy overrides."""
+    from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+    n = 8
+    dag = random_gossip_dag(n, 400, seed=23)
+    eng = WideHashgraph(dag.participants, verify_signatures=False,
+                        e_cap=384, s_cap=96, r_cap=32, n_blocks=2,
+                        auto_compact=True, seq_window=8,
+                        round_margin=1, compact_min=16)
+    for i in range(0, len(dag.events), 100):
+        for ev in dag.events[i:i + 100]:
+            eng.insert_event(ev.clone())
+        eng.run_consensus()
+    snap = snapshot_bytes(eng)
+    restored = load_snapshot(
+        snap, verify_events=False,
+        expected_participants=eng.participants,
+        policy={"verify_signatures": False},
+    )
+    assert restored.known() == eng.known()
+    assert restored.consensus_events() == eng.consensus_events()
+    restored.run_consensus()   # and it keeps working after the swap
