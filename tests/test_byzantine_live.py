@@ -270,12 +270,15 @@ def test_byzantine_node_fleet_end_to_end():
         conf = dataclasses.replace(
             Config.test_config(heartbeat=0.02), byzantine=True, fork_k=3,
             tcp_timeout=5.0, consensus_interval=0.5,
-            # pre-sized pipeline shapes: every node compiles ONE fork
-            # pipeline at boot instead of a timing-dependent bucket
-            # growth sequence — on a 1-core host those growth re-jits
-            # (tens of seconds each, under the core lock) starve gossip
-            # long enough to flake the fleet assertions
+            # pre-sized pipeline shapes + a window that stays INSIDE
+            # them: every node compiles ONE fork pipeline at boot, and
+            # the rolling window (seq_window x 4 creators + unordered
+            # tail << e_cap) never grows past the pre-size — otherwise
+            # a mid-run bucket re-jit (tens of seconds on a 1-core
+            # host, under the core lock) starves gossip long enough to
+            # flake the fleet assertions
             fork_caps=(1024, 64, 16),
+            cache_size=512, seq_window=32,
         )
         nodes = [
             Node(conf, keys[i], peers, transports[i], proxies[i])
