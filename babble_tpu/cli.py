@@ -34,7 +34,7 @@ def cmd_keygen(args) -> int:
     return 0
 
 
-def _parse_fork_caps(spec: str):
+def _parse_fork_caps(spec: str, flag: str = "--fork_caps"):
     """'e,s,r' -> (e, s, r), failing at the flag instead of as a bare
     IndexError inside the consensus loop."""
     if not spec:
@@ -42,14 +42,14 @@ def _parse_fork_caps(spec: str):
     parts = spec.split(",")
     if len(parts) != 3:
         raise SystemExit(
-            f"--fork_caps wants exactly 'e,s,r' (got {spec!r})"
+            f"{flag} wants exactly 'e,s,r' (got {spec!r})"
         )
     try:
         caps = tuple(int(x) for x in parts)
     except ValueError:
-        raise SystemExit(f"--fork_caps values must be integers: {spec!r}")
+        raise SystemExit(f"{flag} values must be integers: {spec!r}")
     if any(v <= 0 for v in caps):
-        raise SystemExit(f"--fork_caps values must be positive: {spec!r}")
+        raise SystemExit(f"{flag} values must be positive: {spec!r}")
     return caps
 
 
@@ -91,11 +91,12 @@ async def _run_node(args) -> int:
         from .store.checkpoint import engine_mode
 
         mode = engine_mode(engine)
-        want = "byzantine" if args.byzantine else "fused"
-        if (mode == "byzantine") != (want == "byzantine"):
+        want = ("byzantine" if args.byzantine
+                else getattr(args, "engine", "fused"))
+        if mode != want:
             raise SystemExit(
                 f"checkpoint {ckpt_dir} engine kind '{mode}' does not "
-                f"match --byzantine={bool(args.byzantine)}"
+                f"match the configured engine '{want}'"
             )
         if mode == "byzantine":
             caps = _parse_fork_caps(getattr(args, "fork_caps", ""))
@@ -118,6 +119,9 @@ async def _run_node(args) -> int:
         byzantine=args.byzantine,
         fork_k=args.fork_k,
         fork_caps=_parse_fork_caps(getattr(args, "fork_caps", "")),
+        engine=getattr(args, "engine", "fused"),
+        wide_caps=_parse_fork_caps(getattr(args, "wide_caps", ""),
+                                   flag="--wide_caps"),
     )
     conf.logger.setLevel(args.log_level.upper())
 
@@ -404,6 +408,15 @@ def main(argv=None) -> int:
                     help="pre-sized byzantine pipeline capacities "
                          "'e,s,r' (one jit shape at boot instead of "
                          "demand-driven growth recompiles)")
+    rn.add_argument("--engine", default="fused",
+                    choices=("fused", "wide"),
+                    help="honest-mode engine: fused [E,N] coordinate "
+                         "tensors, or the column-blocked rolling-window "
+                         "wide engine (the 10k-participant layout)")
+    rn.add_argument("--wide_caps", default="",
+                    help="wide-engine window capacities 'e,s,r' "
+                         "(fixed at boot; the engine compacts instead "
+                         "of growing)")
     rn.add_argument("--seq_window", type=int, default=0,
                     help="per-creator rolling window (0 = cache_size)")
     rn.add_argument("--jax_cache", default="",

@@ -45,6 +45,15 @@ class Config:
     # rejecting them (the reference's only answer, hashgraph.go:366-396).
     byzantine: bool = False
     fork_k: int = 2      # branch slots per creator (fork budget K-1)
+    # Honest-mode engine selection: "fused" (default; la/fd as [E+1, N]
+    # device tensors) or "wide" (column-blocked rolling window — the
+    # 10k-participant memory layout behind the same Core surface,
+    # consensus/wide_engine.py).  Byzantine mode ignores this.
+    engine: str = "fused"
+    # Wide-engine window capacities (e_cap, s_cap, r_cap); None derives
+    # a default from cache_size.  Fixed at boot — the wide engine
+    # compacts instead of growing.
+    wide_caps: tuple | None = None
     # Pre-sized byzantine pipeline capacities (e_cap, s_cap, r_cap).
     # None = grow monotone buckets on demand.  Pre-sizing makes every
     # node compile ONE pipeline shape at boot instead of a timing-

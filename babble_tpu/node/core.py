@@ -31,6 +31,8 @@ class Core:
         byzantine: bool = False,
         fork_k: int = 2,
         fork_caps: Optional[tuple] = None,
+        wide: bool = False,
+        wide_caps: Optional[tuple] = None,
     ):
         self.id = core_id
         self.key = key
@@ -61,6 +63,23 @@ class Core:
                 seq_window=min(seq_window or cache_size or 256, 256),
                 compact_min=max((cache_size or 256) // 4, 32),
                 initial_caps=fork_caps,
+            )
+        elif wide:
+            # column-blocked rolling-window engine (the wide-N memory
+            # layout) behind the same Core surface; capacities are a
+            # boot-time contract — the engine compacts instead of
+            # growing (consensus/wide_engine.py)
+            from ..consensus.wide_engine import WideHashgraph
+
+            cs = cache_size or 4096
+            wc = wide_caps or (max(8 * cs, 4096), 256, 64)
+            self.hg = WideHashgraph(
+                participants, commit_callback=commit_callback,
+                e_cap=wc[0], s_cap=wc[1], r_cap=wc[2],
+                auto_compact=True,
+                seq_window=min(seq_window or cs, wc[1] // 2),
+                round_margin=1,
+                consensus_window=2 * cs,   # commit log bounded too
             )
         else:
             # The live path runs with rolling windows on (auto_compact):
@@ -128,12 +147,15 @@ class Core:
         into the new engine; if any of it is not insertable there (an
         other-parent outside the snapshot window), bootstrap refuses and
         the old engine stays in place."""
-        from ..consensus.fork_engine import ForkHashgraph
+        from ..store.checkpoint import engine_mode
 
-        if isinstance(engine, ForkHashgraph) != self.byzantine:
+        # full KIND check, not just byzantine-ness: a wide core must
+        # not silently adopt a fused snapshot (abandoning the memory
+        # layout the operator configured) or vice versa
+        if engine_mode(engine) != engine_mode(self.hg):
             raise ValueError(
-                "bootstrap engine mode does not match this core's "
-                f"(byzantine={self.byzantine})"
+                f"bootstrap engine kind '{engine_mode(engine)}' does "
+                f"not match this core's '{engine_mode(self.hg)}'"
             )
         if self.byzantine:
             self._bootstrap_fork(engine)

@@ -65,6 +65,8 @@ class Node:
             byzantine=conf.byzantine,
             fork_k=conf.fork_k,
             fork_caps=conf.fork_caps,
+            wide=(getattr(conf, "engine", "fused") == "wide"),
+            wide_caps=conf.wide_caps,
         )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
@@ -378,6 +380,18 @@ class Node:
                     # explicit: the restore falls back to the PEER's
                     # serialized value for missing/None entries, and a
                     # hostile round_margin would freeze our window
+                    "round_margin": 1,
+                }
+            elif getattr(self.conf, "engine", "fused") == "wide":
+                # mirror Core's wide boot knobs; the restore path
+                # additionally clamps seq_window to the snapshot's
+                # s_cap//2 (the shapes are the snapshot's, not ours)
+                policy = {
+                    "verify_signatures": True,
+                    "auto_compact": True,
+                    "seq_window": self.conf.seq_window or cs or 256,
+                    "consensus_window": 2 * cs if cs else None,
+                    "compact_min": None,
                     "round_margin": 1,
                 }
             else:

@@ -685,6 +685,12 @@ def _restore_wide_engine(
     auto_compact, seq_window, round_margin, compact_min, cons_window = (
         meta["policy"]
     )
+    # the wide engine's in-window chain depth must stay under s_cap:
+    # clamp whatever seq_window the policy/snapshot produced, exactly
+    # like Core's boot path (a fast-forward must not install a window
+    # the restored shapes cannot hold)
+    sw = policy.get("seq_window", seq_window) or seq_window
+    sw = min(sw, max(1, cfg.s_cap // 2))
     engine = WideHashgraph(
         participants,
         commit_callback=commit_callback,
@@ -694,8 +700,8 @@ def _restore_wide_engine(
         e_cap=cfg.e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
         n_blocks=int(meta["n_blocks"]),
         auto_compact=policy.get("auto_compact", auto_compact),
-        seq_window=policy.get("seq_window", seq_window),
-        round_margin=policy.get("round_margin", round_margin),
+        seq_window=sw,
+        round_margin=policy.get("round_margin", round_margin) or round_margin,
         compact_min=policy.get("compact_min", compact_min),
         consensus_window=policy.get("consensus_window", cons_window),
         coord8=cfg.coord8,
