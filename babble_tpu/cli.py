@@ -104,6 +104,20 @@ async def _run_node(args) -> int:
                 # the checkpoint carries no capacity hints: re-apply the
                 # pre-sizing or every resume pays the growth re-jits
                 engine.pre_size(caps)
+        elif mode == "wide":
+            want_caps = _parse_fork_caps(getattr(args, "wide_caps", ""),
+                                         flag="--wide_caps")
+            have = (engine.cfg.e_cap, engine.cfg.s_cap, engine.cfg.r_cap)
+            if want_caps and tuple(want_caps) != have:
+                # wide capacities are fixed at boot; the snapshot's
+                # shapes win on resume — say so instead of letting the
+                # operator believe the flag took effect
+                print(
+                    f"warning: --wide_caps {want_caps} ignored — the "
+                    f"resumed checkpoint's window capacities are {have} "
+                    "and cannot change post-boot",
+                    file=sys.stderr,
+                )
         n_ev = (len(engine.dag.events) if mode == "byzantine"
                 else engine.dag.n_events)
         print(f"resumed from checkpoint {ckpt_dir}: "

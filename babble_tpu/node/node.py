@@ -354,7 +354,7 @@ class Node:
         babbleio fast-sync assumption — signed state proofs are the
         known hardening).  Pooled transactions survive the swap and ride
         the next self-event."""
-        from ..store.checkpoint import load_snapshot
+        from ..store.checkpoint import engine_mode, load_snapshot
 
         if self._fast_forwarding:
             return
@@ -382,15 +382,19 @@ class Node:
                     # hostile round_margin would freeze our window
                     "round_margin": 1,
                 }
-            elif getattr(self.conf, "engine", "fused") == "wide":
-                # mirror Core's wide boot knobs; the restore path
+            elif engine_mode(self.core.hg) == "wide":
+                # mirror Core's wide boot knobs exactly (cs fallback
+                # included — the wide engine's fixed-memory contract
+                # requires a bounded commit log and active compaction
+                # no matter what cache_size says); the restore path
                 # additionally clamps seq_window to the snapshot's
                 # s_cap//2 (the shapes are the snapshot's, not ours)
+                cs_eff = cs or 4096
                 policy = {
                     "verify_signatures": True,
                     "auto_compact": True,
-                    "seq_window": self.conf.seq_window or cs or 256,
-                    "consensus_window": 2 * cs if cs else None,
+                    "seq_window": self.conf.seq_window or cs_eff,
+                    "consensus_window": 2 * cs_eff,
                     "compact_min": None,
                     "round_margin": 1,
                 }

@@ -269,7 +269,11 @@ def test_byzantine_node_fleet_end_to_end():
         # and consensus amortized on a cadence, or gossip starves
         conf = dataclasses.replace(
             Config.test_config(heartbeat=0.02), byzantine=True, fork_k=3,
-            tcp_timeout=5.0, consensus_interval=0.5,
+            # a sync must RIDE OUT a compile stall under the peer's
+            # core lock rather than time out and thrash (in-suite the
+            # XLA CPU compiles run several times slower than in a
+            # fresh process)
+            tcp_timeout=30.0, consensus_interval=0.5,
             # pre-sized pipeline shapes + a window that stays INSIDE
             # them: every node compiles ONE fork pipeline at boot, and
             # the rolling window (seq_window x 4 creators + unordered
@@ -289,6 +293,13 @@ def test_byzantine_node_fleet_end_to_end():
         byz_cid = nodes[0].core.participants[byz_key.pub_hex]
         for nd in nodes:
             nd.init()
+        # deterministic pre-gossip warmup: the first run_consensus
+        # compiles the (pre-sized, shared-in-process) fork pipeline
+        # BEFORE gossip starts, so no node ever holds its core lock
+        # through a compile storm mid-fleet
+        for nd in nodes:
+            nd.core.run_consensus()
+        for nd in nodes:
             nd.run_task(gossip=True)
         try:
             # let gossip warm up, then equivocate: two signed children
