@@ -406,7 +406,19 @@ class ForkHashgraph:
         indexes behind every branch tip, and strictly below the
         smallest first-descendant any UNORDERED event still holds on
         that branch (so median timestamps keep resolving).  Returns the
-        number of evicted slots."""
+        number of evicted slots.
+
+        Known bound: a detected equivocator's excluded branch events
+        are never ordered, and the prefix cut stops at the earliest of
+        them — so the live window floor grows with the equivocator's
+        branch length.  Evicting them would need a proof that an
+        unordered fork event can never be received later (its receive
+        chance at undecided high rounds depends on which witnesses
+        detect the fork), and a wrong guess is consensus divergence —
+        so the engine keeps them.  The fork budget (K-1 branches per
+        creator) bounds branch COUNT; branch length is bounded only by
+        how long peers keep resending, which the seq_window cap on
+        diffs limits per sync."""
         if self._out is None or self._dirty:
             return 0
         cfg, out = self._out

@@ -348,7 +348,7 @@ class Core:
         other_head: str,
         wire_events: List[WireEvent],
         payload: List[bytes],
-    ) -> None:
+    ) -> bool:
         """Insert peer events, then create the new head (core.go:134-157).
 
         Byzantine mode inserts per-event instead of all-or-nothing
@@ -389,14 +389,19 @@ class Core:
         if self.byzantine and other_head not in self.hg.dag.slot_of:
             # the peer's head itself was skipped (its parents reference
             # events we don't hold yet): keep everything inserted, but
-            # the merge event cannot name it — later gossip retries
+            # the merge event cannot name it — later gossip retries.
+            # Returning False tells the node NO self-event carried the
+            # payload, so it must re-queue the pooled transactions
+            # (silently dropping them here lost txs forever whenever a
+            # fleet's fork-resend raced the merge head).
             self.insert_failures += 1
             self.last_insert_error = "peer head not insertable; merge skipped"
-            return
+            return False
         ev = new_event(
             payload, (self.head, other_head), self.key.pub_bytes, self.seq + 1
         )
         self.sign_and_insert_self_event(ev)
+        return True
 
     def add_self_event(self, payload: List[bytes]) -> None:
         """Self-parent-only event carrying pooled txs (used when there is

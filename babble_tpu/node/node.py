@@ -426,6 +426,14 @@ class Node:
                 )
                 self.validate_ff_snapshot(engine)
                 self.core.bootstrap(engine)
+                if (engine_mode(engine) == "byzantine"
+                        and self.conf.fork_caps):
+                    # snapshots carry no capacity hints: without the
+                    # re-applied pre-size, the fast-forwarded engine
+                    # would pay the whole demand-driven compile
+                    # sequence again — under the core lock, starving
+                    # gossip right when the node is trying to catch up
+                    engine.pre_size(self.conf.fork_caps)
             window_len = (
                 len(engine.dag.events) if self.core.byzantine
                 else engine.dag.n_events - engine.dag.slot_base
@@ -464,9 +472,14 @@ class Node:
                 # Device compute (incl. the first jit compile) runs in a
                 # worker thread so the loop keeps serving; the async lock
                 # still serializes all core access.
-                await loop.run_in_executor(
+                minted = await loop.run_in_executor(
                     None, self.core.sync, resp.head, resp.events, payload
                 )
+                if minted is False:
+                    # byzantine merge-skip: events inserted but no
+                    # self-event minted — the payload must ride a later
+                    # sync instead of vanishing
+                    self.transaction_pool = payload + self.transaction_pool
             except BaseException:
                 # the sync never produced a self-event carrying the pooled
                 # txs — put them back for the next attempt

@@ -364,7 +364,29 @@ def test_byzantine_node_fleet_end_to_end():
             # window pins the shapes) — and the driver box can be a
             # single core, where those compiles also starve gossip
             # timeouts, so the budget is generous
-            await asyncio.wait_for(settled(), 480)
+            try:
+                await asyncio.wait_for(settled(), 480)
+            except (TimeoutError, asyncio.TimeoutError):
+                diag = []
+                for nd in nodes:
+                    s = nd.get_stats()
+                    held = {
+                        nd.core.hg.dag.events[x].hex()[:8]
+                        for x in nd.core.hg.dag.cr_events[byz_cid]
+                    }
+                    diag.append(
+                        f"node{nd.core.id}: ce={s['consensus_events']} "
+                        f"forked={s.get('forked_creators')} "
+                        f"evicted={s['evicted_events']} "
+                        f"win={s['live_window']} "
+                        f"sync_rate={s['sync_rate']} "
+                        f"has_a={fork_a.hex()[:8] in held} "
+                        f"has_b={fork_b.hex()[:8] in held} "
+                        f"committed={[len(p.committed_transactions()) for p in proxies]}"
+                    )
+                raise AssertionError(
+                    "fleet never settled:\n" + "\n".join(diag)
+                )
 
             # fork detected at every honest node, asserted via the
             # STATS surface a real operator watches (VERDICT r4 weak
