@@ -410,9 +410,13 @@ def run_wide(n, e, coord8=False, r_cap=8, repeats=2, tag=None):
     decided = {r: d for r, d in rtf.items() if d is not None}
     acct = wide_phase_accounting(cfg, best["stats"], best["timings"],
                                  tuple(batch.sched.shape))
+    plat = jax.devices()[0].platform
     detail = {
-        "config": f"{n}x{e}" + ("_int8" if coord8 else ""),
-        "platform": jax.devices()[0].platform,
+        # CPU-fallback entries get their own key: they must never
+        # displace a TPU-measured config in the merged detail file
+        "config": (f"{n}x{e}" + ("_int8" if coord8 else "")
+                   + ("_cpu" if plat == "cpu" else "")),
+        "platform": plat,
         "host_cores": os.cpu_count(),
         "events": e, "participants": n,
         "total_s": round(best["total_s"], 2),
@@ -441,8 +445,18 @@ def run_wide(n, e, coord8=False, r_cap=8, repeats=2, tag=None):
 
 
 def dump_detail() -> None:
+    """Merge this run's entries over the checked-in detail file: a
+    CPU-fallback run must not erase TPU-measured configs it didn't
+    re-run (each entry carries its own platform/host fields)."""
+    merged = {}
+    try:
+        with open("BENCH_DETAIL.json") as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        pass
+    merged.update(DETAIL)
     with open("BENCH_DETAIL.json", "w") as f:
-        json.dump(DETAIL, f, indent=1)
+        json.dump(merged, f, indent=1)
 
 
 def run_byzantine(n: int, e: int, r_cap: int) -> float:
@@ -881,9 +895,14 @@ def run_10k(n: int = 10_000, e: int = 1_000_000,
     rtf = stream.stats.get("fame_decision_distance", {})
     # honest denominator under truncation: only the events actually
     # ingested before the deadline count toward throughput
+    import jax
+
     e_done = stream.stats.get("events_ingested", e)
+    plat = jax.devices()[0].platform
     detail = {
-        "config": f"{n}x{e}_stream_int8",
+        "config": (f"{n}x{e}_stream_int8"
+                   + ("_cpu" if plat == "cpu" else "")),
+        "platform": plat,
         "events": e, "participants": n,
         "events_ingested": e_done,
         "truncated": bool(stream.stats.get("truncated", False)),
