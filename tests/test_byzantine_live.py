@@ -432,3 +432,48 @@ def test_byzantine_node_fleet_end_to_end():
                 await nd.shutdown()
 
     asyncio.run(go())
+
+
+def test_sync_merge_skip_reports_unminted_payload():
+    """A byzantine sync whose peer head is not insertable must tell the
+    caller NO self-event carried the payload (returning None here once
+    silently lost pooled transactions forever — the node re-queues on
+    False)."""
+    keys, participants, cores = _mk_cores(2)
+    # a head hash core0 has never seen: parents unknown -> merge skipped
+    ghost = new_event([b"g"], ("ff" * 32, "ee" * 32),
+                      keys[1].pub_bytes, 7)
+    ghost.sign(keys[1])
+    seq_before = cores[0].seq
+    minted = cores[0].sync(ghost.hex(), [], [b"precious-tx"])
+    assert minted is False
+    assert cores[0].seq == seq_before, "merge event should not exist"
+    # a normal sync mints and reports True
+    diff = cores[1].diff(cores[0].known())
+    minted = cores[0].sync(cores[1].head, cores[1].to_wire(diff),
+                           [b"precious-tx"])
+    assert minted is True
+    assert cores[0].seq == seq_before + 1
+
+
+def test_gossip_backoff_capped_and_resettable():
+    """ADVICE r4 medium #2: the per-creator resync backoff must never
+    under-advertise below the local retained window depth (advertising
+    under a peer's eviction point turns every sync into TooLate), and
+    too_late resets it outright."""
+    keys, participants, cores = _mk_cores(2)
+    diff = cores[1].diff(cores[0].known())
+    cores[0].sync(cores[1].head, cores[1].to_wire(diff), [])
+
+    cid = 1
+    depth = len(cores[0].hg.dag.cr_events[cid])
+    true_count = cores[0].hg.known()[cid]
+    # simulate many missing-ancestry failures: backoff doubles way past
+    # the window depth
+    cores[0]._creator_backoff[cid] = 1 << 18
+    advertised = cores[0].known()[cid]
+    assert advertised == max(0, true_count - depth), (
+        "backoff must cap at the retained window depth"
+    )
+    cores[0].reset_gossip_backoff()
+    assert cores[0].known()[cid] == true_count
