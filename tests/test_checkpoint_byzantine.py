@@ -13,6 +13,8 @@ Invariants:
   as the honest path (structural bounds before object construction).
 """
 
+import asyncio
+
 import msgpack
 import pytest
 
@@ -223,3 +225,119 @@ def test_fork_bootstrap_refuses_snapshot_forking_us(tmp_path):
                             expected_participants=participants)
     cores[2].bootstrap(engine2)
     assert cores[2].head  # still has a live head afterwards
+
+
+@pytest.mark.slow
+def test_byzantine_rejoin_after_window():
+    """VERDICT r4 item 8's live half: a byzantine-mode node whose Known
+    fell below the fleet's rolling window catches up via the byzantine
+    fast-forward snapshot — which ships the fork-detection state — and
+    then keeps committing alongside the fleet."""
+    import dataclasses
+
+    from babble_tpu.core.event import new_event
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.net import InmemNetwork, Peer
+    from babble_tpu.node import Config, Node
+    from babble_tpu.proxy.inmem import InmemAppProxy
+
+    async def go():
+        n = 4
+        keys = sorted([generate_key() for _ in range(n)],
+                      key=lambda k: k.pub_hex)
+        net = InmemNetwork()
+        transports = [net.transport(f"inmem://{i}") for i in range(n)]
+        peers = [
+            Peer(net_addr=t.local_addr(), pub_key_hex=k.pub_hex)
+            for t, k in zip(transports, keys)
+        ]
+        conf = dataclasses.replace(
+            Config.test_config(heartbeat=0.01), byzantine=True, fork_k=2,
+            tcp_timeout=30.0, consensus_interval=0.3,
+            fork_caps=(512, 32, 8), cache_size=64, seq_window=8,
+        )
+        proxies = [InmemAppProxy() for _ in range(n)]
+        nodes = [
+            Node(conf, keys[i], peers, transports[i], proxies[i])
+            for i in range(n)
+        ]
+        for nd in nodes:
+            nd.init()
+        for nd in nodes:
+            nd.core.run_consensus()   # pre-gossip pipeline warmup
+
+        async def wait_until(cond, why):
+            """State each condition once (the sibling fleet-test
+            idiom): poll, and surface `why` on timeout."""
+            async def poll():
+                while not cond():
+                    await asyncio.sleep(0.5)
+
+            try:
+                await asyncio.wait_for(poll(), 300)
+            except (TimeoutError, asyncio.TimeoutError):
+                raise AssertionError(why)
+
+        straggler = n - 1
+        net.disconnect_all(transports[straggler].local_addr())
+        for nd in nodes[:straggler]:
+            nd.run_task()
+        try:
+            # majority evicts past the straggler's Known (honest
+            # traffic — the fork comes later, AFTER eviction, because
+            # excluded branch events pin the evictable prefix)
+            await wait_until(
+                lambda: all(nd.core.hg.dag.evicted > 8
+                            for nd in nodes[:straggler]),
+                "majority never evicted",
+            )
+
+            # one of the MAJORITY creators equivocates: fork off node
+            # 1's current tip, planted at node 0 (node 1 keeps its own
+            # honest continuation) — detection spreads through gossip
+            byz_cid = 1
+            dag0 = nodes[0].core.hg.dag
+            tip = dag0.events[dag0.cr_events[byz_cid][-1]]
+            forged = new_event([b"two-faced"],
+                               (tip.hex(), nodes[0].core.head),
+                               keys[byz_cid].pub_bytes, tip.index + 1)
+            forged.sign(keys[byz_cid])
+            async with nodes[0].core_lock:
+                nodes[0].core.insert_event(forged)
+
+            await wait_until(
+                lambda: all(
+                    int(nd.get_stats().get("forked_creators", "0")) >= 1
+                    for nd in nodes[:straggler]
+                ),
+                "majority never detected the fork",
+            )
+
+            # reconnect: too_late -> byzantine fast-forward carrying
+            # the detection state
+            for other in range(n):
+                net.connect(transports[straggler].local_addr(),
+                            transports[other].local_addr())
+                net.connect(transports[other].local_addr(),
+                            transports[straggler].local_addr())
+            nodes[straggler].run_task()
+
+            await wait_until(
+                lambda: nodes[straggler].core.hg.dag.evicted > 0,
+                "straggler never fast-forwarded",
+            )
+            assert int(
+                nodes[straggler].get_stats().get("forked_creators", "0")
+            ) >= 1, "fast-forward lost the fork-detection state"
+
+            base = nodes[straggler].core.hg.consensus_events_count()
+            await wait_until(
+                lambda: (nodes[straggler].core.hg.consensus_events_count()
+                         > base + 10),
+                "rejoined byzantine node made no progress",
+            )
+        finally:
+            for nd in nodes:
+                await nd.shutdown()
+
+    asyncio.run(go())
