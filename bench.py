@@ -829,7 +829,13 @@ def main() -> None:
         # windowed wide pipeline streams events through a rolling
         # window until ordering exists at n=10k
         stage("10k_stream")
-        d = _gated("10k", 420, run_10k)
+        # low static estimate: the stream now stops CLEANLY at its own
+        # internal deadline (remaining budget minus headroom) and lands
+        # partial per-batch evidence, so attempting with a modest
+        # remainder is strictly better than skipping (VERDICT r4 weak
+        # #6: the old 420 s gate was an unvalidated guess that could
+        # silently skip the north-star config)
+        d = _gated("10k", 240, run_10k)
         if d is not None:
             _SUMMARY["ordered_10k"] = d.get("ordered")
             _SUMMARY["rounds_to_fame_10k"] = d.get(
