@@ -1,0 +1,64 @@
+"""babble-lint: repo-native static analysis (stdlib-only, tier-1).
+
+Rule families (see ISSUE 1 / the rules' module docstrings):
+
+- :mod:`.tracer` — JAX tracer safety inside jitted functions
+- :mod:`.races` — asyncio interleaving races across ``await``
+- :mod:`.invariants` — drain-before-validate + falsy-config fallback
+
+Run as ``python -m babble_tpu.analysis [--format=text|json] [paths]``;
+suppress a finding with ``# babble-lint: disable=<rule-name>`` on the
+flagged line (or the line above).  The full rule set runs over
+``babble_tpu/`` in tier-1 (tests/test_static_analysis.py), so a new
+finding — or a blanket suppression — fails the build.
+
+Adding a rule: subclass :class:`~.engine.Rule`, implement
+``check(ctx)``, append an instance to :data:`ALL_RULES`.  Keep rules
+stdlib-only — this package must import in environments without jax.
+"""
+
+from .engine import (
+    BAD_SUPPRESSION,
+    PARSE_ERROR,
+    FileContext,
+    Finding,
+    Rule,
+    check_file,
+    run_paths,
+)
+from .invariants import DrainBeforeValidateRule, FalsyOrFallbackRule
+from .races import AwaitStateRaceRule
+from .tracer import (
+    JitHostSyncRule,
+    JitTracedBranchRule,
+    JitUnhashableStaticRule,
+)
+
+ALL_RULES = [
+    JitTracedBranchRule(),
+    JitHostSyncRule(),
+    JitUnhashableStaticRule(),
+    AwaitStateRaceRule(),
+    DrainBeforeValidateRule(),
+    FalsyOrFallbackRule(),
+]
+
+RULE_NAMES = {r.name for r in ALL_RULES} | {BAD_SUPPRESSION, PARSE_ERROR}
+
+__all__ = [
+    "ALL_RULES",
+    "RULE_NAMES",
+    "BAD_SUPPRESSION",
+    "PARSE_ERROR",
+    "FileContext",
+    "Finding",
+    "Rule",
+    "check_file",
+    "run_paths",
+    "AwaitStateRaceRule",
+    "DrainBeforeValidateRule",
+    "FalsyOrFallbackRule",
+    "JitHostSyncRule",
+    "JitTracedBranchRule",
+    "JitUnhashableStaticRule",
+]

@@ -1,0 +1,79 @@
+"""babble-lint CLI: ``python -m babble_tpu.analysis [paths...]``.
+
+Exit status is the contract CI keys off: 0 = clean, 1 = findings,
+2 = usage error.  ``--format=json`` emits a machine-readable finding
+list (one array, not JSONL) for tooling; text format is
+``path:line:col: rule: message`` — the same shape compilers use, so
+editors and CI annotators parse it for free.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import ALL_RULES
+from .engine import run_paths
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m babble_tpu.analysis",
+        description="babble-lint: repo-native static analysis for JAX "
+                    "tracer safety, asyncio races and consensus "
+                    "invariants.",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["babble_tpu"],
+        help="files or directories to check (default: babble_tpu)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    parser.add_argument(
+        "--rules", default=None, metavar="RULE[,RULE...]",
+        help="run only the named rules (default: all)",
+    )
+    args = parser.parse_args(argv)
+
+    rules = list(ALL_RULES)
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {r.name for r in rules}
+        unknown = wanted - known
+        if unknown:
+            print(f"unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    if args.list_rules:
+        for r in sorted(ALL_RULES, key=lambda r: r.name):
+            print(f"{r.name}: {r.description}")
+        return 0
+
+    # a path that matches nothing is a usage error, not a clean run —
+    # exit 0 must mean "these files were checked and are clean", or a
+    # typo'd CI invocation stays green forever
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such file or directory: {missing}", file=sys.stderr)
+        return 2
+
+    findings = run_paths(args.paths, rules,
+                         known_rules={r.name for r in ALL_RULES})
+    if args.format == "json":
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0

@@ -1,0 +1,213 @@
+"""babble-lint core: rule registry, suppression handling, file runner.
+
+Why a repo-native linter instead of more pylint plugins: the bug
+classes that threaten this codebase are *domain* invariants — Python
+control flow on JAX tracers inside jitted kernels, shared-state
+mutation across ``await`` in the gossip loop, draining a queue before
+the capacity guard that protects it, ``or``-fallbacks that eat explicit
+falsy config — none of which a general-purpose linter models.  Each
+rule here encodes one mechanically-detectable bug class that has
+actually bitten the tree (see ISSUE 1 / ADVICE.md round 5).
+
+Design: a rule is a class with ``name``/``description`` metadata and a
+``check(ctx)`` generator over :class:`Finding`; the engine owns file
+discovery, AST parsing and suppression filtering, so adding a rule is
+one visitor class plus a registry entry.  Everything is stdlib-only
+(``ast`` + ``tokenize``): the linter must run in environments where
+jax / cryptography are absent, because it is tier-1.
+
+Suppression syntax::
+
+    something_flagged()  # babble-lint: disable=rule-name
+    # babble-lint: disable=rule-a,rule-b   (own line: applies to next line)
+
+Blanket disables are themselves findings (``bad-suppression``): every
+suppression must carry the names of real rules, so ``--list-rules``
+stays an honest inventory of what is NOT checked where.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+class FileContext:
+    """Parsed view of one source file, shared by every rule."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``description`` and implement
+    ``check``.  ``name`` is the suppression/CLI identifier (kebab-case)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=ctx.path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+# ----------------------------------------------------------------------
+# suppressions
+
+_SUPPRESS_RE = re.compile(r"#\s*babble-lint:\s*disable=([A-Za-z0-9_.,\- ]*)")
+# a suppression comment that names nothing, or names a wildcard
+_BLANKET = {"", "all", "*"}
+
+BAD_SUPPRESSION = "bad-suppression"
+PARSE_ERROR = "parse-error"
+
+
+def parse_suppressions(
+    source: str, path: str, known_rules: Set[str]
+) -> tuple[Dict[int, Set[str]], List[Finding]]:
+    """Map 1-based line number -> suppressed rule names.
+
+    Only real COMMENT tokens count (the syntax quoted in a docstring is
+    documentation, not a directive).  A trailing comment suppresses its
+    own line; a comment alone on a line suppresses the next line.
+    Returns (map, bad-suppression findings) — blanket or unknown-rule
+    suppressions are errors, not silently honored."""
+    suppressed: Dict[int, Set[str]] = {}
+    bad: List[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return suppressed, bad  # the parse-error path reports this file
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        i, col = tok.start
+        own_line = tok.line.lstrip().startswith("#")
+        names = {p.strip() for p in m.group(1).split(",") if p.strip()}
+        if not names or names & _BLANKET:
+            bad.append(Finding(
+                BAD_SUPPRESSION, path, i, col,
+                "blanket suppression: name the rule(s) being disabled "
+                "(babble-lint: disable=<rule-name>)",
+            ))
+            continue
+        unknown = names - known_rules
+        if unknown:
+            bad.append(Finding(
+                BAD_SUPPRESSION, path, i, col,
+                f"suppression names unknown rule(s): {sorted(unknown)}",
+            ))
+            names -= unknown
+        if own_line:
+            suppressed.setdefault(i + 1, set()).update(names)
+        else:
+            suppressed.setdefault(i, set()).update(names)
+    return suppressed, bad
+
+
+# ----------------------------------------------------------------------
+# runner
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "_build")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        else:
+            # an explicitly named file is always checked, whatever its
+            # extension — skipping it would let the CLI exit 0 ("checked
+            # and clean") having checked nothing; a non-Python file
+            # surfaces as a parse-error finding instead
+            yield p
+
+
+def check_file(
+    path: str, rules: Sequence[Rule],
+    known_rules: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Run ``rules`` over one file.  ``known_rules`` is the vocabulary
+    suppressions may legally name — pass the FULL rule set even when
+    running a subset, so a suppression for an unselected rule is not
+    misreported as unknown."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding(PARSE_ERROR, path, 0, 0, f"unreadable: {e}")]
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Finding(
+            PARSE_ERROR, path, e.lineno or 0, e.offset or 0,
+            f"syntax error: {e.msg}",
+        )]
+
+    known = known_rules if known_rules is not None else {
+        r.name for r in rules
+    }
+    suppressed, findings = parse_suppressions(source, path, known)
+    for rule in rules:
+        for f in rule.check(ctx):
+            if f.rule in suppressed.get(f.line, ()):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def run_paths(
+    paths: Iterable[str], rules: Sequence[Rule],
+    known_rules: Optional[Set[str]] = None,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(check_file(path, rules, known_rules))
+    return findings

@@ -1,0 +1,164 @@
+"""Asyncio shared-state race detection.
+
+The gossip runtime (node/node.py, net/, fleet.py) is single-threaded
+asyncio, so races here are not data races but *interleaving* races:
+every ``await`` is a scheduling point where another coroutine of the
+same node may run and observe or overwrite shared attributes.  The bug
+shape this rule targets: a coroutine mutates ``self.x``, awaits, then
+mutates ``self.x`` again — between the two writes the object is in a
+state the author thought was private, and a second task entering the
+same method corrupts it (lost updates, double-drains, torn multi-field
+invariants).
+
+A write is exempt when it happens under a held lock — any ``with`` /
+``async with`` whose context expression mentions ``lock`` or ``mutex``
+in an attribute/variable name (``async with self.core_lock:``).  The
+await itself may be inside or outside the lock: holding a lock across
+an await still yields the loop, but other writers of the same attr are
+excluded, which is the invariant that matters.
+
+Heuristic boundaries: statements are linearized in source order (a
+write in an ``if`` arm counts as "before" a later await even when the
+branch is not taken at runtime), and lock detection is by name.  Both
+favor recall: a false positive documents itself with a named
+suppression; a missed race corrupts a node.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Tuple
+
+from .engine import FileContext, Finding, Rule
+
+_LOCKISH = {"lock", "mutex", "sem", "semaphore"}
+# identifier -> words: snake_case segments and camelCase humps, so
+# `core_lock`/`coreLock` match but `block_writer`/`assembler` do not
+# (substring matching would read the `lock` inside `block` as a lock)
+_WORD_RE = re.compile(r"[A-Z]?[a-z0-9]+|[A-Z]+(?![a-z])")
+
+
+def _lockish_name(name: str) -> bool:
+    return any(w.lower() in _LOCKISH for w in _WORD_RE.findall(name))
+
+
+def _names_lock(node: ast.AST) -> bool:
+    """Does this with-context expression look like a lock acquisition?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and _lockish_name(sub.attr):
+            return True
+        if isinstance(sub, ast.Name) and _lockish_name(sub.id):
+            return True
+    return False
+
+
+class AwaitStateRaceRule(Rule):
+    name = "await-state-race"
+    description = (
+        "coroutine mutates the same self.<attr> both before and after "
+        "an await without holding a lock — another task can interleave "
+        "at the await and observe/clobber the intermediate state"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                yield from self._check_coroutine(ctx, node)
+
+    def _check_coroutine(
+        self, ctx: FileContext, fn: ast.AsyncFunctionDef
+    ) -> Iterator[Finding]:
+        # events: ("write", attr, node, locked) | ("await", None, node, _)
+        events: List[Tuple[str, str, ast.AST, bool]] = []
+        self._collect(fn.body, locked=False, events=events)
+
+        seen_await_after_write = {}  # attr -> first unlocked write node
+        pending: dict = {}
+        for kind, attr, node, locked in events:
+            if kind == "await":
+                for a, n in pending.items():
+                    seen_await_after_write.setdefault(a, n)
+                pending.clear()
+                continue
+            if locked:
+                continue
+            if attr in seen_await_after_write:
+                yield self.finding(
+                    ctx, node,
+                    f"self.{attr} is written both before (line "
+                    f"{seen_await_after_write[attr].lineno}) and after an "
+                    f"await in `{fn.name}` without a lock — an "
+                    "interleaving task sees the intermediate state",
+                )
+                # report once per attr per coroutine
+                del seen_await_after_write[attr]
+                continue
+            pending.setdefault(attr, node)
+
+    def _collect(self, body: List[ast.stmt], locked: bool,
+                 events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes have their own schedule
+            self._collect_stmt(stmt, locked, events)
+
+    def _awaits_in(self, expr: ast.AST, locked: bool,
+                   events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Await):
+                events.append(("await", "", node, locked))
+
+    def _collect_stmt(self, stmt: ast.stmt, locked: bool,
+                      events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._awaits_in(item.context_expr, locked, events)
+            if isinstance(stmt, ast.AsyncWith):
+                # `async with x:` awaits __aenter__ even without an
+                # explicit Await node in the source
+                events.append(("await", "", stmt, locked))
+            inner_locked = locked or any(
+                _names_lock(item.context_expr) for item in stmt.items
+            )
+            self._collect(stmt.body, inner_locked, events)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._awaits_in(stmt.test, locked, events)
+            self._collect(stmt.body, locked, events)
+            self._collect(stmt.orelse, locked, events)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._awaits_in(stmt.iter, locked, events)
+            if isinstance(stmt, ast.AsyncFor):
+                events.append(("await", "", stmt, locked))
+            self._collect(stmt.body, locked, events)
+            self._collect(stmt.orelse, locked, events)
+        elif isinstance(stmt, ast.Try):
+            self._collect(stmt.body, locked, events)
+            for h in stmt.handlers:
+                self._collect(h.body, locked, events)
+            self._collect(stmt.orelse, locked, events)
+            self._collect(stmt.finalbody, locked, events)
+        else:
+            # simple statement: awaits evaluate before the binding lands
+            self._awaits_in(stmt, locked, events)
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    self._collect_write(t, stmt, locked, events)
+
+    def _collect_write(self, target: ast.AST, stmt: ast.stmt, locked: bool,
+                       events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._collect_write(elt, stmt, locked, events)
+        elif isinstance(target, ast.Starred):
+            self._collect_write(target.value, stmt, locked, events)
+        elif (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            events.append(("write", target.attr, stmt, locked))

@@ -134,9 +134,13 @@ class WideHashgraph(TpuHashgraph):
                     "even after compaction — raise e_cap or gossip less "
                     "per sync"
                 )
-        sp, op, creator, seq, ts, mbit, sched = self.dag.take_pending()
-
-        # in-window chain depth must fit the ce table (ops/stream.py)
+        # in-window chain depth must fit the ce table (ops/stream.py).
+        # Checked BEFORE the queue is drained: a raise after the drain
+        # would strand the batch outside both the host queue and the
+        # device window, leaving the engine silently corrupted — the
+        # refused batch must stay pending so the caller can recover
+        # (raise s_cap via a rebuilt engine, or gossip smaller syncs).
+        sp, op, creator, seq, ts, mbit, sched = self.dag.peek_pending()
         s_off = np.asarray(self.state.s_off[: self.cfg.n])
         depth = int(np.max(seq - s_off[creator], initial=0))
         if depth >= self.cfg.s_cap:
@@ -144,6 +148,7 @@ class WideHashgraph(TpuHashgraph):
                 f"in-window chain depth {depth} >= s_cap {self.cfg.s_cap}:"
                 " raise s_cap or shrink seq_window"
             )
+        self.dag.drop_pending()
 
         kpad = _bucket(k)
         t, b = sched.shape

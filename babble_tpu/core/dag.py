@@ -174,8 +174,19 @@ class HostDag:
         *device-local* parent slots (global - slot_base); sched holds batch
         positions (0-based within this batch), -1 padding.
         """
-        slots = self.pending
+        batch = self.peek_pending()
         self.pending = []
+        return batch
+
+    def drop_pending(self) -> None:
+        """Drain the pending queue after a successful peek_pending — the
+        two-step form engines use to validate a batch (capacity / chain
+        depth) BEFORE consuming it, so a refused batch stays queued."""
+        self.pending = []
+
+    def peek_pending(self) -> Tuple[np.ndarray, ...]:
+        """take_pending's array build WITHOUT draining the queue."""
+        slots = self.pending
         base = self.slot_base
         k = len(slots)
         sp = np.empty(k, np.int32)

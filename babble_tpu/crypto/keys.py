@@ -9,6 +9,15 @@ Reference parity:
 Implementation uses the `cryptography` hazmat layer rather than a hand-rolled
 curve; signatures are exchanged as raw (r, s) integer pairs exactly like the
 reference wire format, not DER.
+
+The `cryptography` dependency is gated: hashing (sha256) and hex identity
+helpers are stdlib and must work everywhere — consensus engines run with
+``verify_signatures=False`` and fake (r, s) scalars in simulation and most
+tests, and only nodes that actually sign/verify wire events need ECDSA.
+Importing this module never fails: when `cryptography` is unavailable the
+same API is served by :mod:`._fallback` (pure-Python P-256 — correct and
+wire-compatible, but not side-channel hardened; install `cryptography`
+for production signing).
 """
 
 from __future__ import annotations
@@ -18,18 +27,38 @@ import os
 from dataclasses import dataclass
 from typing import Tuple
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives.hashes import SHA256
+from . import _fallback as _fb
 
-_CURVE = ec.SECP256R1()
-_PREHASHED = ec.ECDSA(Prehashed(SHA256()))
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.hashes import SHA256
+
+    _HAVE_CRYPTO = True
+    _CURVE = ec.SECP256R1()
+    _PREHASHED = ec.ECDSA(Prehashed(SHA256()))
+except ImportError:  # pragma: no cover - exercised in minimal envs
+    # plain ImportError too: a present-but-broken cryptography install
+    # (missing libssl, ABI mismatch) must also fall back, not crash
+    import warnings
+
+    _HAVE_CRYPTO = False
+    ec = None  # type: ignore[assignment]
+    # the downgrade must be observable: the fallback is correct but not
+    # constant-time, so a production operator needs a signal
+    warnings.warn(
+        "cryptography is not importable; ECDSA uses the pure-Python "
+        "P-256 fallback (not side-channel hardened) — install "
+        "'cryptography' for production signing",
+        RuntimeWarning,
+        stacklevel=2,
+    )
 
 
 def sha256(data: bytes) -> bytes:
@@ -59,16 +88,22 @@ class KeyPair:
 
 
 def generate_key() -> KeyPair:
+    if not _HAVE_CRYPTO:
+        return KeyPair(_fb.generate_private_key())
     return KeyPair(ec.generate_private_key(_CURVE))
 
 
 def sign(private: ec.EllipticCurvePrivateKey, digest: bytes) -> Tuple[int, int]:
     """Sign a 32-byte SHA-256 digest; returns raw (r, s) scalars."""
+    if isinstance(private, _fb.FallbackPrivateKey):
+        return _fb.sign(private, digest)
     der = private.sign(digest, _PREHASHED)
     return decode_dss_signature(der)
 
 
 def verify(public: ec.EllipticCurvePublicKey, digest: bytes, r: int, s: int) -> bool:
+    if isinstance(public, _fb.FallbackPublicKey):
+        return _fb.verify(public, digest, r, s)
     try:
         public.verify(encode_dss_signature(r, s), digest, _PREHASHED)
         return True
@@ -81,6 +116,8 @@ def verify(public: ec.EllipticCurvePublicKey, digest: bytes, r: int, s: int) -> 
 def pub_bytes(public: ec.EllipticCurvePublicKey) -> bytes:
     """Uncompressed SEC1 point (0x04 || X || Y), 65 bytes — the reference's
     elliptic.Marshal encoding (crypto/utils.go:46-49)."""
+    if isinstance(public, _fb.FallbackPublicKey):
+        return public.sec1()
     return public.public_bytes(
         serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
     )
@@ -93,6 +130,8 @@ def pub_hex(public: ec.EllipticCurvePublicKey) -> str:
 
 
 def from_pub_bytes(data: bytes) -> ec.EllipticCurvePublicKey:
+    if not _HAVE_CRYPTO:
+        return _fb.FallbackPublicKey.from_sec1(data)
     return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, data)
 
 
@@ -112,17 +151,23 @@ class PemKeyFile:
 
     def read(self) -> KeyPair:
         with open(self.path, "rb") as f:
-            key = serialization.load_pem_private_key(f.read(), password=None)
+            data = f.read()
+        if not _HAVE_CRYPTO:
+            return KeyPair(_fb.private_key_from_pem(data))
+        key = serialization.load_pem_private_key(data, password=None)
         if not isinstance(key, ec.EllipticCurvePrivateKey):
             raise ValueError("priv_key.pem does not contain an EC private key")
         return KeyPair(key)
 
     def write(self, key: KeyPair) -> None:
-        pem = key.private.private_bytes(
-            serialization.Encoding.PEM,
-            serialization.PrivateFormat.TraditionalOpenSSL,
-            serialization.NoEncryption(),
-        )
+        if isinstance(key.private, _fb.FallbackPrivateKey):
+            pem = _fb.private_key_pem(key.private)
+        else:
+            pem = key.private.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(self.path, "wb") as f:
             f.write(pem)
@@ -134,6 +179,11 @@ class PemKeyFile:
 def pem_dump(key: KeyPair) -> Tuple[str, str]:
     """(private_pem, public_pem) strings — the `keygen` CLI output
     (reference cmd/main.go keygen + crypto/pem_key.go GeneratePemKey)."""
+    if isinstance(key.private, _fb.FallbackPrivateKey):
+        return (
+            _fb.private_key_pem(key.private).decode(),
+            _fb.public_key_pem(key.private.public_key()).decode(),
+        )
     priv = key.private.private_bytes(
         serialization.Encoding.PEM,
         serialization.PrivateFormat.TraditionalOpenSSL,

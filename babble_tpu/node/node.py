@@ -460,7 +460,10 @@ class Node:
                 "fast-forward from %s failed: %s", peer_addr, e
             )
         finally:
-            self._fast_forwarding = False
+            # deliberate re-entrancy flag: set before the awaits, checked
+            # at entry, cleared in the finally — the check-then-set pair
+            # has no await between them, so no second task can slip in
+            self._fast_forwarding = False  # babble-lint: disable=await-state-race
 
     async def _process_sync_response(self, resp: SyncResponse) -> None:
         loop = asyncio.get_running_loop()

@@ -64,7 +64,10 @@ class JsonStream:
             chunk = await self.reader.read(65536)
             if not chunk:
                 return None
-            self.buf += chunk.decode(errors="replace")
+            # single-consumer contract: one JsonStream per connection,
+            # drained by exactly one handler coroutine (socket_app/
+            # jsonrpc _handle loops never call next_obj concurrently)
+            self.buf += chunk.decode(errors="replace")  # babble-lint: disable=await-state-race
 
 
 class JsonRpcServer:

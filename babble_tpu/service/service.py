@@ -83,9 +83,11 @@ class Service:
                 await asyncio.sleep(seconds)
             finally:
                 # in the finally: a cancelled request must not leave the
-                # global profiler tracing the event loop forever
+                # global profiler tracing the event loop forever.  The
+                # flag is a deliberate busy-guard (checked at entry with
+                # no await before the set) — not an interleaving race.
                 prof.disable()
-                self._profiling = False
+                self._profiling = False  # babble-lint: disable=await-state-race
             buf = io.StringIO()
             pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(60)
             return buf.getvalue().encode(), "200 OK", "text/plain"
@@ -117,7 +119,8 @@ class Service:
                 # _profiling permanently
                 if started:
                     jax.profiler.stop_trace()
-                self._profiling = False
+                # same busy-guard pattern as /debug/profile above
+                self._profiling = False  # babble-lint: disable=await-state-race
             body = json.dumps({"trace_dir": out_dir, "seconds": seconds})
             return body.encode(), "200 OK", "application/json"
         return b'{"error": "not found"}', "404 Not Found", "application/json"

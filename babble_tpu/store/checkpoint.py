@@ -297,6 +297,57 @@ def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
     for v in meta["sp_slot"] + meta["op_slot"]:
         if not (-1 <= v < ne):
             raise ValueError("snapshot parent slot out of range")
+    # Chain-extent plausibility: br_extent values become absolute chain
+    # indices (diff()/fast-forward arithmetic) and cr_evicted feeds the
+    # gossip vector clock — unbounded values let a hostile snapshot
+    # wedge every future diff against us, and a negative one underflows
+    # the known() comparison.  No branch can extend past the total
+    # number of slots ever inserted, and no creator can have had more
+    # slots evicted than were evicted overall.
+    evicted = meta["evicted"]
+    if not isinstance(evicted, int) or not (0 <= evicted <= 1 << 48):
+        raise ValueError(f"snapshot evicted={evicted!r} out of bounds")
+    total_slots = evicted + ne
+    for col in range(b):
+        ext = meta["br_extent"][col]
+        if not isinstance(ext, int) or not (0 <= ext <= total_slots):
+            raise ValueError(
+                f"snapshot br_extent[{col}]={ext!r} out of bounds "
+                f"(window holds {ne} events, {evicted} evicted)"
+            )
+        # a branch's divergence index sits strictly inside its extent
+        # (-1/0 for roots); past it, common_prefix walks garbage
+        div = meta["br_div"][col]
+        if not isinstance(div, int) or not (-1 <= div < max(ext, 1)):
+            raise ValueError(
+                f"snapshot br_div[{col}]={div!r} outside [-1, "
+                f"{max(ext, 1)})"
+            )
+    cr_ev = meta["cr_evicted"]
+    if any(not isinstance(v, int) or v < 0 for v in cr_ev) or \
+            sum(cr_ev) > evicted:
+        raise ValueError(
+            f"snapshot cr_evicted={cr_ev!r} inconsistent with "
+            f"{evicted} total evicted slots"
+        )
+    # Level consistency: levels drive the per-level kernel schedule
+    # (every event strictly after its parents).  A level that is not
+    # strictly greater than both in-window parents' would let two
+    # mutually-ancestral events share a schedule row — the coordinate
+    # scan then reads a stale la/fd row and every predicate downstream
+    # is silently wrong.  (Events with evicted parents are pseudo-roots;
+    # any non-negative level is plausible for them.)
+    levels = meta["levels"]
+    for i, lvl in enumerate(levels):
+        if not isinstance(lvl, int) or not (0 <= lvl <= 1 << 24):
+            raise ValueError(f"snapshot levels[{i}]={lvl!r} out of bounds")
+    for i in range(ne):
+        for p in (meta["sp_slot"][i], meta["op_slot"][i]):
+            if p >= 0 and levels[i] <= levels[p]:
+                raise ValueError(
+                    f"snapshot levels[{i}]={levels[i]} not greater than "
+                    f"parent slot {p}'s level {levels[p]}"
+                )
     for v in meta["ebr"]:
         if not (0 <= v < b):
             raise ValueError("snapshot branch column out of range")
@@ -322,6 +373,17 @@ def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
             raise ValueError("snapshot chain tip out of range")
 
 
+def _pol(policy: dict, key: str, snap_val):
+    """Policy override with a None sentinel, shared by every restore
+    path: an explicit falsy value (``seq_window=0``) is real
+    configuration and must be honored; only an absent key or an
+    explicit ``None`` falls back to the snapshot's value.  Never use
+    ``policy.get(k, snap) or snap`` here (babble-lint
+    falsy-or-fallback — the historical checkpoint.py bug class)."""
+    v = policy.get(key, snap_val)
+    return snap_val if v is None else v
+
+
 def _restore_fork_engine(
     meta: dict,
     commit_callback: Optional[Callable] = None,
@@ -336,8 +398,7 @@ def _restore_fork_engine(
     policy = policy or {}
 
     def pol(key, snap_val):
-        v = policy.get(key, snap_val)
-        return snap_val if v is None else v
+        return _pol(policy, key, snap_val)
 
     participants = {kk: int(v) for kk, v in meta["participants"]}
     auto_compact, round_margin, seq_window, compact_min = meta["policy"]
@@ -608,15 +669,15 @@ def _restore_engine(
     engine = TpuHashgraph(
         participants,
         commit_callback=commit_callback,
-        verify_signatures=policy.get(
-            "verify_signatures", meta["verify_signatures"]
+        verify_signatures=_pol(
+            policy, "verify_signatures", meta["verify_signatures"]
         ),
         e_cap=cfg.e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
-        auto_compact=policy.get("auto_compact", auto_compact),
-        seq_window=policy.get("seq_window", seq_window),
-        round_margin=policy.get("round_margin", round_margin),
-        compact_min=policy.get("compact_min", compact_min),
-        consensus_window=policy.get("consensus_window", cons_window),
+        auto_compact=_pol(policy, "auto_compact", auto_compact),
+        seq_window=_pol(policy, "seq_window", seq_window),
+        round_margin=_pol(policy, "round_margin", round_margin),
+        compact_min=_pol(policy, "compact_min", compact_min),
+        consensus_window=_pol(policy, "consensus_window", cons_window),
     )
     engine.cfg = cfg
 
@@ -689,21 +750,21 @@ def _restore_wide_engine(
     # clamp whatever seq_window the policy/snapshot produced, exactly
     # like Core's boot path (a fast-forward must not install a window
     # the restored shapes cannot hold)
-    sw = policy.get("seq_window", seq_window) or seq_window
-    sw = min(sw, max(1, cfg.s_cap // 2))
+    sw = min(_pol(policy, "seq_window", seq_window),
+             max(1, cfg.s_cap // 2))
     engine = WideHashgraph(
         participants,
         commit_callback=commit_callback,
-        verify_signatures=policy.get(
-            "verify_signatures", meta["verify_signatures"]
+        verify_signatures=_pol(
+            policy, "verify_signatures", meta["verify_signatures"]
         ),
         e_cap=cfg.e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
         n_blocks=int(meta["n_blocks"]),
-        auto_compact=policy.get("auto_compact", auto_compact),
+        auto_compact=_pol(policy, "auto_compact", auto_compact),
         seq_window=sw,
-        round_margin=policy.get("round_margin", round_margin) or round_margin,
-        compact_min=policy.get("compact_min", compact_min),
-        consensus_window=policy.get("consensus_window", cons_window),
+        round_margin=_pol(policy, "round_margin", round_margin),
+        compact_min=_pol(policy, "compact_min", compact_min),
+        consensus_window=_pol(policy, "consensus_window", cons_window),
         coord8=cfg.coord8,
     )
     engine.cfg = cfg

@@ -1,0 +1,7 @@
+"""Fixture: a blanket suppression is itself a finding AND does not
+silence the rule it tried to hide."""
+
+
+def lookup(cfg):
+    # babble-lint: disable=all
+    return cfg.get("k", 5) or 5  # MARK: falsy-or-fallback (+ bad-suppression above)

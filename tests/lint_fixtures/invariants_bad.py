@@ -1,0 +1,27 @@
+"""Fixture: consensus host-state invariant violations — the
+wide_engine.flush drain-then-guard shape and the checkpoint falsy-or
+config fallback."""
+
+
+class Window:
+    def __init__(self, cap):
+        self.cap = cap
+        self.items = []
+
+    def flush(self):
+        batch = self.items.pop()
+        if len(batch) > self.cap:  # MARK: drain-before-validate
+            raise ValueError("batch overruns the window")
+        return batch
+
+    def flush_fixed(self):
+        # clean: the guard runs before anything is consumed
+        if self.items and len(self.items[-1]) > self.cap:
+            raise ValueError("batch overruns the window")
+        return self.items.pop()
+
+
+def load_policy(cfg):
+    size = cfg.get("seq_window", 16) or 16  # MARK: falsy-or-fallback
+    margin = cfg.get("round_margin", 1)  # clean: no or-fallback
+    return size, margin

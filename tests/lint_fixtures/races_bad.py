@@ -1,0 +1,30 @@
+"""Fixture: asyncio interleaving race — shared attribute mutated on
+both sides of an await with no lock held."""
+
+import asyncio
+
+
+class Pipeline:
+    def __init__(self):
+        self.pending = []
+        self.core_lock = asyncio.Lock()
+
+    async def drain_unlocked(self, items):
+        self.pending = list(items)
+        await asyncio.sleep(0)  # another task may run here
+        self.pending = []  # MARK: await-state-race
+
+    async def drain_locked(self, items):
+        # clean: both writes happen under the lock
+        async with self.core_lock:
+            self.pending = list(items)
+            await asyncio.sleep(0)
+            self.pending = []
+
+    async def drain_block_guard(self, items):
+        # `block_writer` is NOT a lock — the `lock` inside `block` must
+        # not exempt these writes (word-boundary matching)
+        async with self.block_writer:
+            self.pending = list(items)
+            await asyncio.sleep(0)
+            self.pending = []  # MARK: await-state-race

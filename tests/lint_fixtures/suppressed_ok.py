@@ -1,0 +1,19 @@
+"""Fixture: a real finding silenced by a correctly-named suppression —
+the linter must report nothing for this file."""
+
+import asyncio
+
+
+class Guarded:
+    def __init__(self):
+        self.busy = False
+
+    async def run_once(self):
+        if self.busy:
+            return
+        self.busy = True
+        try:
+            await asyncio.sleep(0)
+        finally:
+            # busy-guard flag, checked at entry before any await
+            self.busy = False  # babble-lint: disable=await-state-race

@@ -1,0 +1,60 @@
+"""Fixture: JAX tracer-safety violations.  Parsed by the linter tests,
+never imported or executed — each marked line must produce exactly the
+named finding."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def branch_on_traced(x):
+    if x > 0:  # MARK: jit-traced-branch
+        return x + 1
+    return x - 1
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def host_sync(n, x):
+    total = jnp.sum(x)
+    val = total.item()  # MARK: jit-host-sync
+    arr = np.asarray(x)  # MARK: jit-host-sync
+    return val + arr.sum() + n
+
+
+@jax.jit
+def iterate_traced(xs):
+    acc = 0
+    for v in xs:  # MARK: jit-traced-branch
+        acc = acc + v
+    return acc
+
+
+def _impl(cfg, x):
+    y = x * 2
+    while y.sum() > 0:  # MARK: jit-traced-branch
+        y = y - 1
+    return float(y[0]) + cfg  # MARK: jit-host-sync
+
+
+_stepped = jax.jit(_impl, static_argnums=[0])  # MARK: jit-unhashable-static
+
+
+@jax.jit
+def nested_sync(x):
+    # the sync sits two blocks deep: it must be reported exactly ONCE,
+    # not once per enclosing block (the static .shape branches are fine)
+    if x.shape[0] > 2:
+        if x.ndim > 1:
+            return x.sum().item()  # MARK: jit-host-sync
+    return x
+
+
+@jax.jit
+def shape_branch_is_fine(x):
+    # .shape / len() of a tracer are static: no finding on this branch
+    if x.shape[0] > len(x.shape):
+        return x.sum()
+    return x

@@ -177,6 +177,74 @@ def test_fork_snapshot_hostile_meta_rejected():
         load_snapshot(hostile2, verify_events=False)
 
 
+def test_fork_snapshot_hostile_extent_levels_rejected():
+    """ISSUE 1 satellite 3: _check_fork_meta bounds the chain-extent /
+    eviction-clock fields and requires levels consistent with the
+    declared parents — a hostile snapshot must not be able to wedge the
+    gossip vector clock (br_extent/cr_evicted), walk garbage in
+    common_prefix (br_div), or corrupt the per-level kernel schedule
+    (levels), all BEFORE any object is built."""
+    dag, eng = _build(n=5, n_events=120)
+    for ev in dag.events:
+        eng.insert_event(ev)
+    eng.run_consensus()
+    snap = snapshot_bytes(eng)
+    meta_b, npz_b = msgpack.unpackb(snap, raw=False)
+    meta = msgpack.unpackb(meta_b, raw=False, strict_map_key=False)
+
+    def repack(m):
+        return msgpack.packb(
+            [msgpack.packb(m, use_bin_type=True), npz_b],
+            use_bin_type=True,
+        )
+
+    # branch extent past every slot ever inserted
+    lied = dict(meta)
+    lied["br_extent"] = list(meta["br_extent"])
+    lied["br_extent"][0] = 1 << 50
+    with pytest.raises(ValueError, match="br_extent"):
+        load_snapshot(repack(lied), verify_events=False)
+
+    # divergence index outside [-1, extent)
+    used_col = next(c for c, u in enumerate(meta["br_used"]) if u)
+    lied = dict(meta)
+    lied["br_div"] = list(meta["br_div"])
+    lied["br_div"][used_col] = meta["br_extent"][used_col] + 3
+    with pytest.raises(ValueError, match="br_div"):
+        load_snapshot(repack(lied), verify_events=False)
+
+    # per-creator eviction clocks: negative, or summing past the total
+    lied = dict(meta)
+    lied["cr_evicted"] = list(meta["cr_evicted"])
+    lied["cr_evicted"][0] = -1
+    with pytest.raises(ValueError, match="cr_evicted"):
+        load_snapshot(repack(lied), verify_events=False)
+    lied["cr_evicted"][0] = int(meta["evicted"]) + 1
+    with pytest.raises(ValueError, match="cr_evicted"):
+        load_snapshot(repack(lied), verify_events=False)
+
+    # a level not strictly above an in-window parent's level would let
+    # mutually-ancestral events share a schedule row
+    i = next(
+        i for i in range(len(meta["sp_slot"])) if meta["sp_slot"][i] >= 0
+    )
+    lied = dict(meta)
+    lied["levels"] = list(meta["levels"])
+    lied["levels"][i] = meta["levels"][meta["sp_slot"][i]]
+    with pytest.raises(ValueError, match="levels"):
+        load_snapshot(repack(lied), verify_events=False)
+
+    # negative total-evicted counter
+    lied = dict(meta)
+    lied["evicted"] = -5
+    with pytest.raises(ValueError, match="evicted"):
+        load_snapshot(repack(lied), verify_events=False)
+
+    # the untouched snapshot still restores after all that
+    restored = load_snapshot(snap, verify_events=False)
+    assert restored.known() == eng.known()
+
+
 def test_fork_bootstrap_refuses_snapshot_forking_us(tmp_path):
     """A snapshot that records an equivocation by OUR key must be
     refused: adopting it (or replaying our tail onto a diverged view of

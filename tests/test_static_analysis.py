@@ -1,0 +1,204 @@
+"""Tier-1 gate for babble-lint (babble_tpu/analysis).
+
+Two contracts, both part of every verify run:
+
+1. the repo itself is CLEAN under the full rule set — a new finding
+   (or a blanket suppression) fails the build, which is what makes the
+   rule engine a regression fence rather than advice;
+2. each rule family actually detects its bug class — checked against
+   fixtures under tests/lint_fixtures/ that reproduce the historical
+   defects (wide_engine s_cap drain-before-validate, checkpoint
+   falsy-or policy fallback, jit tracer branching, gossip await races).
+
+This module is deliberately stdlib-only (the analysis package must
+import without jax/cryptography) so the gate runs even in minimal
+environments.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from babble_tpu.analysis import ALL_RULES, RULE_NAMES, check_file, run_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "babble_tpu")
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _marked_lines(path, rule):
+    """1-based lines tagged ``# MARK: <rule>`` in a fixture."""
+    with open(path, encoding="utf-8") as f:
+        return {
+            i for i, line in enumerate(f, start=1)
+            if f"MARK: {rule}" in line
+        }
+
+
+def _found_lines(findings, rule):
+    return {f.line for f in findings if f.rule == rule}
+
+
+# ----------------------------------------------------------------------
+# the repo gate
+
+def test_repo_tree_is_clean():
+    findings = run_paths([PKG], ALL_RULES, known_rules=RULE_NAMES)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_rule_catalog_well_formed():
+    names = [r.name for r in ALL_RULES]
+    assert len(names) == len(set(names)), "duplicate rule names"
+    for r in ALL_RULES:
+        assert r.name and r.name == r.name.lower(), r.name
+        assert " " not in r.name, f"rule name {r.name!r} is not a slug"
+        assert r.description, f"rule {r.name} has no description"
+    # the four ISSUE-1 rule families are all represented
+    assert {"jit-traced-branch", "jit-host-sync", "jit-unhashable-static",
+            "await-state-race", "drain-before-validate",
+            "falsy-or-fallback"} <= set(names)
+
+
+def test_every_suppression_in_tree_names_a_rule():
+    """No blanket disables anywhere: each suppression comment carries
+    the name of a real rule.  (The engine reports violations as
+    bad-suppression findings; this test states the invariant directly
+    over every comment token in the package.)"""
+    from babble_tpu.analysis.engine import (
+        iter_python_files,
+        parse_suppressions,
+    )
+
+    for path in iter_python_files([PKG]):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        _, bad = parse_suppressions(source, path, RULE_NAMES)
+        assert bad == [], "\n".join(b.format() for b in bad)
+
+
+# ----------------------------------------------------------------------
+# rule families vs fixtures
+
+def test_tracer_fixture_findings():
+    path = _fixture("tracer_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    for rule in ("jit-traced-branch", "jit-host-sync",
+                 "jit-unhashable-static"):
+        assert _found_lines(findings, rule) == _marked_lines(path, rule), (
+            rule, [f.format() for f in findings]
+        )
+    # nesting depth must not duplicate findings: exactly one finding
+    # per flagged location (the MARK lines), no repeats
+    locations = [(f.rule, f.line) for f in findings]
+    assert len(locations) == len(set(locations)), [
+        f.format() for f in findings
+    ]
+    # the .shape/len() branch in shape_branch_is_fine must NOT fire
+    with open(path, encoding="utf-8") as f:
+        clean_start = next(
+            i for i, line in enumerate(f, start=1)
+            if "def shape_branch_is_fine" in line
+        )
+    assert all(f.line < clean_start for f in findings), [
+        f.format() for f in findings
+    ]
+
+
+def test_races_fixture_findings():
+    path = _fixture("races_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "await-state-race") == _marked_lines(
+        path, "await-state-race"
+    ), [f.format() for f in findings]
+    # the locked variant reports nothing; the block_writer (not a
+    # lock) variant does
+    assert len(findings) == 2
+
+
+def test_invariants_fixture_findings():
+    path = _fixture("invariants_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    for rule in ("drain-before-validate", "falsy-or-fallback"):
+        assert _found_lines(findings, rule) == _marked_lines(path, rule), (
+            rule, [f.format() for f in findings]
+        )
+    assert len(findings) == 2
+
+
+def test_named_suppression_is_honored():
+    findings = check_file(_fixture("suppressed_ok.py"), ALL_RULES,
+                          known_rules=RULE_NAMES)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_blanket_suppression_is_rejected_and_ignored():
+    findings = check_file(_fixture("blanket_bad.py"), ALL_RULES,
+                          known_rules=RULE_NAMES)
+    rules = {f.rule for f in findings}
+    # the blanket disable is itself an error AND fails to silence
+    assert "bad-suppression" in rules
+    assert "falsy-or-fallback" in rules
+
+
+# ----------------------------------------------------------------------
+# CLI contract (the acceptance-criteria surface)
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "babble_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = _run_cli("babble_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exits_nonzero_with_locations_on_fixtures():
+    proc = _run_cli(os.path.join("tests", "lint_fixtures"))
+    assert proc.returncode == 1
+    # findings carry file:line anchors for every family
+    for rule in ("jit-traced-branch", "jit-host-sync",
+                 "jit-unhashable-static", "await-state-race",
+                 "drain-before-validate", "falsy-or-fallback"):
+        assert rule in proc.stdout, (rule, proc.stdout)
+    import re
+
+    assert re.search(r"lint_fixtures[/\\]\w+\.py:\d+:\d+: ", proc.stdout)
+
+
+def test_cli_json_format():
+    proc = _run_cli("--format=json", os.path.join("tests", "lint_fixtures"))
+    assert proc.returncode == 1
+    data = json.loads(proc.stdout)
+    assert isinstance(data, list) and data
+    assert {"rule", "path", "line", "col", "message"} <= set(data[0])
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for r in ALL_RULES:
+        assert r.name in proc.stdout
+
+
+def test_cli_nonexistent_path_is_a_usage_error():
+    # exit 0 must mean "checked and clean", never "checked nothing":
+    # a typo'd CI path has to fail loudly
+    proc = _run_cli("no_such_dir_xyz")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "no_such_dir_xyz" in proc.stderr
+
+
+def test_cli_rule_subset_keeps_suppression_vocabulary():
+    # running a single rule must not misreport suppressions that name
+    # other (real) rules as unknown
+    proc = _run_cli("--rules=falsy-or-fallback", "babble_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -188,6 +188,92 @@ def test_wide_engine_checkpoint_roundtrip_and_resume(tmp_path):
     assert resumed.known() == eng.known()
 
 
+def test_wide_engine_scap_overrun_raises_before_drain():
+    """A burst whose in-window chain depth overruns s_cap must be
+    REFUSED, not half-swallowed: flush() raises before take_pending()
+    so the batch stays queued and the device window is untouched (the
+    validate-before-mutate bug class — babble-lint drain-before-
+    validate, ISSUE 1 satellite 1)."""
+    n = 8
+    dag = random_gossip_dag(n, 400, seed=5)
+    eng = WideHashgraph(dag.participants, verify_signatures=False,
+                        e_cap=1024, s_cap=16, r_cap=32, n_blocks=2,
+                        auto_compact=True, seq_window=8,
+                        round_margin=1, compact_min=16)
+    for ev in dag.events:
+        eng.insert_event(ev.clone())
+    n_pending = len(eng.dag.pending)
+    known_before = eng.known()
+    assert n_pending == len(dag.events)
+
+    with pytest.raises(ValueError, match="s_cap"):
+        eng.flush()
+
+    # nothing was drained and nothing reached the device window
+    assert len(eng.dag.pending) == n_pending
+    assert eng.stream.n_live == 0
+    assert eng.known() == known_before
+    # the failure is deterministic, not a one-shot corruption: the same
+    # refusal repeats instead of silently "succeeding" on retry
+    with pytest.raises(ValueError, match="s_cap"):
+        eng.flush()
+    assert len(eng.dag.pending) == n_pending
+
+    # the same traffic chunked within the depth bound works fine
+    eng2 = WideHashgraph(dag.participants, verify_signatures=False,
+                         e_cap=1024, s_cap=96, r_cap=32, n_blocks=2,
+                         auto_compact=True, seq_window=8,
+                         round_margin=1, compact_min=16)
+    committed = []
+    for i in range(0, len(dag.events), 64):
+        for ev in dag.events[i:i + 64]:
+            eng2.insert_event(ev.clone())
+        committed += eng2.run_consensus()
+    assert committed, "chunked ingest no longer commits"
+
+
+def test_wide_restore_honors_explicit_zero_policy():
+    """policy={"seq_window": 0} / {"round_margin": 0} are explicit
+    configuration, not 'unset': the restore path must use an is-None
+    sentinel, never `or`-fallback to the snapshot values (the
+    checkpoint.py falsy-config bug class — babble-lint
+    falsy-or-fallback, ISSUE 1 satellite 2)."""
+    from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+    n = 8
+    dag = random_gossip_dag(n, 200, seed=29)
+    eng = WideHashgraph(dag.participants, verify_signatures=False,
+                        e_cap=384, s_cap=96, r_cap=32, n_blocks=2,
+                        auto_compact=True, seq_window=8,
+                        round_margin=1, compact_min=16)
+    for ev in dag.events:
+        eng.insert_event(ev.clone())
+    eng.run_consensus()
+    snap = snapshot_bytes(eng)
+
+    restored = load_snapshot(
+        snap, verify_events=False,
+        expected_participants=eng.participants,
+        policy={"seq_window": 0, "round_margin": 0},
+    )
+    assert restored.seq_window == 0, (
+        "explicit seq_window=0 was swallowed by a falsy-or fallback"
+    )
+    assert restored.round_margin == 0, (
+        "explicit round_margin=0 was swallowed by a falsy-or fallback"
+    )
+    assert restored.known() == eng.known()
+
+    # absent keys still fall back to the snapshot's values
+    restored2 = load_snapshot(
+        snap, verify_events=False,
+        expected_participants=eng.participants,
+        policy={"round_margin": None},
+    )
+    assert restored2.seq_window == eng.seq_window
+    assert restored2.round_margin == eng.round_margin
+
+
 def test_wide_engine_fast_forward_snapshot_roundtrip():
     """The wide engine serves and loads fast-forward snapshots (the
     rolling-cache rejoin path): bytes -> engine with the same window,
