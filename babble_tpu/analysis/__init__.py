@@ -4,6 +4,7 @@ Rule families (see ISSUE 1 / the rules' module docstrings):
 
 - :mod:`.tracer` — JAX tracer safety inside jitted functions
 - :mod:`.races` — asyncio interleaving races across ``await``
+- :mod:`.blocking` — blocking calls (time.sleep, socket I/O) in coroutines
 - :mod:`.invariants` — drain-before-validate + falsy-config fallback
 
 Run as ``python -m babble_tpu.analysis [--format=text|json] [paths]``;
@@ -26,6 +27,7 @@ from .engine import (
     check_file,
     run_paths,
 )
+from .blocking import AsyncioBlockingCallRule
 from .invariants import DrainBeforeValidateRule, FalsyOrFallbackRule
 from .races import AwaitStateRaceRule
 from .tracer import (
@@ -39,6 +41,7 @@ ALL_RULES = [
     JitHostSyncRule(),
     JitUnhashableStaticRule(),
     AwaitStateRaceRule(),
+    AsyncioBlockingCallRule(),
     DrainBeforeValidateRule(),
     FalsyOrFallbackRule(),
 ]
@@ -55,6 +58,7 @@ __all__ = [
     "Rule",
     "check_file",
     "run_paths",
+    "AsyncioBlockingCallRule",
     "AwaitStateRaceRule",
     "DrainBeforeValidateRule",
     "FalsyOrFallbackRule",

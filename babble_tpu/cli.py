@@ -158,7 +158,8 @@ async def _run_node(args) -> int:
                       allow_remote_debug=args.allow_remote_debug)
     await service.start()
     print(f"node {node.core.id} listening on {transport.local_addr()}, "
-          f"stats on http://{service.bind_addr}/Stats")
+          f"stats on http://{service.bind_addr}/Stats, "
+          f"metrics on http://{service.bind_addr}/metrics")
 
     saver = None
     if ckpt_dir:
@@ -369,6 +370,23 @@ def cmd_fleet(args) -> int:
             fl.bombard_hosts(layout, args.rate, args.duration))
         print(f"submitted {sent} transactions")
         return 0
+    if args.fleet_cmd == "scrape":
+        rows = fl.scrape_hosts(layout)
+        if args.json:
+            print(json.dumps(rows, indent=1))
+        else:
+            # one exposition blob per host, comment-separated so the
+            # output stays valid Prometheus text; failures go to stderr
+            # and flip the exit code (a silent half-sweep reads as a
+            # healthy fleet)
+            for row in rows:
+                if "metrics" in row:
+                    print(f"# ==== {row['host']} ====")
+                    print(row["metrics"], end="")
+                else:
+                    print(f"{row['host']}: {row['kind']}: {row['error']}",
+                          file=sys.stderr)
+        return 0 if all("metrics" in r for r in rows) else 1
     raise SystemExit(f"unknown fleet subcommand {args.fleet_cmd}")
 
 
@@ -492,6 +510,7 @@ def main(argv=None) -> int:
     for name, hlp in (
         ("conf", "node datadirs + peers.json + ssh deploy scripts"),
         ("watch", "poll every host's /Stats"),
+        ("scrape", "sweep every host's /metrics (Prometheus text)"),
         ("bombard", "flood transactions across the hosts"),
     ):
         sp = fsub.add_parser(name, help=hlp)
@@ -505,6 +524,10 @@ def main(argv=None) -> int:
         if name == "watch":
             sp.add_argument("--interval", type=float, default=2.0)
             sp.add_argument("--once", action="store_true")
+        if name == "scrape":
+            sp.add_argument("--json", action="store_true",
+                            help="emit the sweep as a JSON row list "
+                                 "instead of concatenated text")
         if name == "bombard":
             sp.add_argument("--rate", type=float, default=50.0, help="tx/s")
             sp.add_argument("--duration", type=float, default=10.0)

@@ -36,6 +36,7 @@ runs in bounded memory with bounded jit shapes forever.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
@@ -44,6 +45,7 @@ import numpy as np
 from ..common import OffsetList
 from ..core.dag import HostDag
 from ..core.event import Event
+from ..obs import SIZE_BUCKETS
 from ..ops.ingest import EventBatch
 from ..ops.state import DagConfig, bucket as _bucket
 from ..ops.stream import WideStream, _padded_schedule
@@ -76,6 +78,7 @@ class WideHashgraph(TpuHashgraph):
         compact_min: Optional[int] = None,
         consensus_window: Optional[int] = None,
         coord8: bool = False,
+        registry=None,
     ):
         # no super().__init__: it would allocate the fused [E+1, N]
         # la/fd tensors this engine exists to avoid
@@ -96,8 +99,23 @@ class WideHashgraph(TpuHashgraph):
         self.stream = WideStream(
             self.cfg, n_blocks=n_blocks, round_margin=round_margin,
             seq_window=seq_window, record_ordered=False,
+            registry=registry,
         )
         self.state = self.stream.state
+        # flush telemetry (ISSUE 2 tentpole): how many events each
+        # drained batch carries and how long the device-side coords
+        # phase takes per drain — the per-sync device cost /Stats's
+        # averages could never attribute
+        reg = self.stream.registry
+        self._m_flush_events = reg.histogram(
+            "babble_wide_flush_events",
+            "host events drained per wide-engine flush",
+            buckets=SIZE_BUCKETS,
+        )
+        self._m_flush_seconds = reg.histogram(
+            "babble_wide_flush_seconds",
+            "wide-engine flush wall time (pad + device coords phase)",
+        )
 
         self.consensus = OffsetList()
         self.consensus_transactions = 0
@@ -115,6 +133,7 @@ class WideHashgraph(TpuHashgraph):
         """Drain pending host events through the blocked coords phase."""
         if not self.dag.pending:
             return
+        t_flush = time.perf_counter()
         k = len(self.dag.pending)
         if self.stream.n_live + k > self.cfg.e_cap:
             # compaction under pending events is safe up to the smallest
@@ -183,6 +202,8 @@ class WideHashgraph(TpuHashgraph):
         self.stream.ingest(batch, fd_slot_sched=fd_slot_sched)
         self.state = self.stream.state
         self._view = {}
+        self._m_flush_events.observe(k)
+        self._m_flush_seconds.observe(time.perf_counter() - t_flush)
 
     # ------------------------------------------------------------------
     # consensus pipeline (Core.run_consensus calls these in order)

@@ -23,12 +23,13 @@ from __future__ import annotations
 
 import os
 import stat
+import urllib.error
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .crypto.keys import PemKeyFile, generate_key
 from .net.peers import JSONPeers, Peer
-from .testnet import HTTPException, fetch_stats
+from .testnet import HTTPException, fetch_metrics, fetch_stats
 
 GOSSIP_PORT = 1337   # the reference's conventional ports
 SUBMIT_PORT = 1338   # (terraform/scripts/remote-run.sh:12-19)
@@ -132,6 +133,9 @@ start:
 watch:
 \t__PYTHON__ -m babble_tpu.cli fleet watch --hosts $(HOSTS)
 
+scrape:
+\t__PYTHON__ -m babble_tpu.cli fleet scrape --hosts $(HOSTS)
+
 bombard:
 \t__PYTHON__ -m babble_tpu.cli fleet bombard --hosts $(HOSTS) --rate 100 --duration 10
 
@@ -186,18 +190,65 @@ def write_deploy_scripts(
     return out
 
 
-def watch_hosts(layout: HostLayout) -> List[Dict[str, str]]:
-    """One /Stats sweep across the hosts (terraform/scripts/watch.sh)."""
+def _sweep(layout: HostLayout,
+           fetch: Callable[[str], object],
+           ) -> List[Tuple[int, str, object, Optional[str], str]]:
+    """One ``fetch(service_addr)`` per host; one bad host must not crash
+    the sweep.  "The host is down" and "the host answered garbage" are
+    different operator problems — the first is networking/provisioning,
+    the second a broken, outdated or misbound service — so every failure
+    is classified once, here, for both the /Stats and /metrics sweeps:
+
+    - ``urllib.error.HTTPError`` (a 404 from a pre-telemetry binary, a
+      500ing service): something ANSWERED — ``malformed``, despite
+      HTTPError being an OSError subclass;
+    - ``ValueError`` (json.JSONDecodeError) / ``HTTPException`` (garbage
+      status line): answered, but not the expected body — ``malformed``;
+    - any other ``OSError`` (connect refused / timeout / DNS): nothing
+      answered — ``unreachable``.
+
+    Yields ``(index, addr, result, kind, error)`` rows; ``kind`` is
+    ``None`` on success."""
     rows = []
     for i in range(len(layout.hosts)):
         addr = layout.of(i)["service"]
         try:
-            rows.append(fetch_stats(addr))
-        except (OSError, ValueError, HTTPException) as e:
-            # ValueError covers json.JSONDecodeError from a malformed /Stats
-            # body, HTTPException a garbage status line — one bad host must
-            # not crash the whole watch sweep
-            rows.append({"id": str(i), "error": str(e)})
+            rows.append((i, addr, fetch(addr), None, ""))
+        except (urllib.error.HTTPError, ValueError, HTTPException) as e:
+            rows.append((i, addr, None, "malformed", str(e)))
+        except OSError as e:
+            rows.append((i, addr, None, "unreachable", str(e)))
+    return rows
+
+
+def watch_hosts(layout: HostLayout) -> List[Dict[str, str]]:
+    """One /Stats sweep across the hosts (terraform/scripts/watch.sh).
+    Failure rows carry the :func:`_sweep` ``kind`` (``unreachable`` vs
+    ``malformed``) plus the probed address."""
+    rows = []
+    for i, addr, stats, kind, err in _sweep(layout, fetch_stats):
+        if kind is None:
+            rows.append(stats)
+        else:
+            rows.append({"id": str(i), "host": addr, "error": err,
+                         "kind": kind})
+    return rows
+
+
+def scrape_hosts(layout: HostLayout,
+                 timeout: float = 3.0) -> List[Dict[str, str]]:
+    """Fleet-wide /metrics sweep: one Prometheus text blob per host
+    (ISSUE 2 — the fleet-scale close of the telemetry loop).  Rows are
+    ``{"host", "metrics"}`` on success, ``{"host", "error", "kind"}``
+    on failure with the same unreachable/malformed split as
+    :func:`watch_hosts`."""
+    rows = []
+    for _i, addr, text, kind, err in _sweep(
+            layout, lambda a: fetch_metrics(a, timeout=timeout)):
+        if kind is None:
+            rows.append({"host": addr, "metrics": text})
+        else:
+            rows.append({"host": addr, "error": err, "kind": kind})
     return rows
 
 

@@ -60,6 +60,30 @@ class TCPTransport(Transport):
         self._server = AsyncTcpServer(bind_addr, self._handle_conn)
         self._pool: Dict[str, List[tuple]] = {}
         self._closed = False
+        self._metrics: Optional[dict] = None
+
+    def instrument(self, registry) -> None:
+        """Attach a metrics registry (obs.Registry): wire-level byte
+        counters and pool reuse-vs-dial, the payload-bytes half of the
+        gossip telemetry (ISSUE 2).  Called by the owning Node so the
+        transport's series land on the same /metrics page; without it
+        the transport runs uninstrumented (in-memory test doubles)."""
+        self._metrics = {
+            "bytes_out": registry.counter(
+                "babble_net_bytes_sent_total",
+                "request/response payload bytes written to peers "
+                "(frame headers included)"),
+            "bytes_in": registry.counter(
+                "babble_net_bytes_received_total",
+                "request/response payload bytes read from peers "
+                "(frame headers included)"),
+            "pool_reuse": registry.counter(
+                "babble_net_pool_reuse_total",
+                "outbound RPCs served by a pooled connection"),
+            "pool_dial": registry.counter(
+                "babble_net_pool_dial_total",
+                "outbound RPCs that had to open a fresh connection"),
+        }
 
     async def start(self) -> None:
         requested_port = self._server.bind_addr.rsplit(":", 1)[1]
@@ -98,6 +122,9 @@ class TCPTransport(Transport):
                 writer.close()
                 return
             payload = await reader.readexactly(ln)
+            m = self._metrics
+            if m is not None:
+                m["bytes_in"].inc(_HDR.size + ln)
             req_cls = REQUEST_TYPES.get(rtype)
             if req_cls is None:
                 writer.write(_RHDR.pack(1, 0) + b"")
@@ -130,9 +157,13 @@ class TCPTransport(Transport):
                         f"window or raise the cap)"
                     )
                 writer.write(_RHDR.pack(0, len(body)) + body)
+                if m is not None:
+                    m["bytes_out"].inc(_RHDR.size + len(body))
             except Exception as e:  # handler error -> error frame
                 msg = str(e).encode()[:4096]
                 writer.write(_RHDR.pack(1, len(msg)) + msg)
+                if m is not None:
+                    m["bytes_out"].inc(_RHDR.size + len(msg))
             await writer.drain()
 
     # ------------------------------------------------------------------
@@ -140,10 +171,15 @@ class TCPTransport(Transport):
 
     async def _get_conn(self, target: str):
         pool = self._pool.setdefault(target, [])
+        m = self._metrics
         while pool:
             reader, writer = pool.pop()
             if not writer.is_closing():
+                if m is not None:
+                    m["pool_reuse"].inc()
                 return reader, writer
+        if m is not None:
+            m["pool_dial"].inc()
         host, port = target.rsplit(":", 1)
         return await asyncio.wait_for(
             asyncio.open_connection(host, int(port)), self.timeout
@@ -168,9 +204,12 @@ class TCPTransport(Transport):
         timeout = timeout or self.timeout
         conn = await self._get_conn(target)
         reader, writer = conn
+        m = self._metrics
         try:
             body = req.pack()
             writer.write(_HDR.pack(req.RTYPE, len(body)) + body)
+            if m is not None:
+                m["bytes_out"].inc(_HDR.size + len(body))
             await writer.drain()
             hdr = await asyncio.wait_for(
                 reader.readexactly(_RHDR.size), timeout
@@ -188,6 +227,8 @@ class TCPTransport(Transport):
             payload = await asyncio.wait_for(
                 reader.readexactly(ln), body_timeout
             )
+            if m is not None:
+                m["bytes_in"].inc(_RHDR.size + ln)
             if ok != 0:
                 raise TransportError(payload.decode(errors="replace"))
             resp = req.RESPONSE_CLS.unpack(payload)

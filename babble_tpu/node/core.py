@@ -15,6 +15,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..consensus.engine import TpuHashgraph
 from ..core.event import Event, WireEvent, new_event
 from ..crypto.keys import KeyPair
+from ..obs import Registry
 
 
 class Core:
@@ -33,6 +34,7 @@ class Core:
         fork_caps: Optional[tuple] = None,
         wide: bool = False,
         wide_caps: Optional[tuple] = None,
+        registry: Optional[Registry] = None,
     ):
         self.id = core_id
         self.key = key
@@ -80,6 +82,7 @@ class Core:
                 seq_window=min(seq_window or cs, wc[1] // 2),
                 round_margin=1,
                 consensus_window=2 * cs,   # commit log bounded too
+                registry=registry,
             )
         else:
             # The live path runs with rolling windows on (auto_compact):
@@ -126,6 +129,47 @@ class Core:
                 head_ev = self.hg.dag.events[chain[-1]]
                 self.head = head_ev.hex()
                 self.seq = head_ev.index
+
+        if registry is not None:
+            # sampled at scrape time through self.hg so the gauges stay
+            # correct across a fast-forward engine swap (bootstrap
+            # rebinds self.hg; the callbacks read the live one).  All
+            # are host-side mirrors (stats_snapshot) — no device sync on
+            # a /metrics scrape.  One cached snapshot serves every gauge
+            # of a single exposition pass: the families are read
+            # back-to-back, so a short reuse window keeps the exposed
+            # mirrors mutually consistent (no torn scrape across a
+            # concurrent commit) and builds the snapshot once, not once
+            # per gauge.
+            snap_cache = {"t": float("-inf"), "v": {}}
+
+            def _snap() -> dict:
+                now = time.monotonic()
+                if now - snap_cache["t"] > 0.2:
+                    snap_cache["v"] = self.hg.stats_snapshot()
+                    snap_cache["t"] = now
+                return snap_cache["v"]
+
+            for gname, key in (
+                ("babble_consensus_events", "consensus_events"),
+                ("babble_consensus_transactions", "consensus_transactions"),
+                ("babble_undetermined_events", "undetermined_events"),
+                ("babble_last_consensus_round", "last_consensus_round"),
+                ("babble_evicted_events", "evicted_events"),
+                ("babble_live_window_events", "live_window"),
+            ):
+                registry.gauge(
+                    gname, f"host mirror of /Stats {key}",
+                ).set_function(lambda k=key: _snap().get(k, 0))
+            registry.gauge(
+                "babble_insert_failures",
+                "per-event insert failures tolerated in byzantine mode",
+            ).set_function(lambda: self.insert_failures)
+            if byzantine:
+                registry.gauge(
+                    "babble_forked_creators",
+                    "creators with a detected live equivocation",
+                ).set_function(lambda: _snap().get("forked_creators", 0))
 
     # ------------------------------------------------------------------
 

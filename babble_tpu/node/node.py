@@ -31,9 +31,15 @@ from ..net.commands import (
 )
 from ..net.peers import Peer, canonical_ids
 from ..net.transport import Transport, TransportError
+from ..obs import LoopLagProbe, Registry, SpanTracer
 from .config import Config
 from .core import Core
 from .peer_selector import RandomPeerSelector
+
+#: /Stats timing keys are rendered from these phase histograms; the
+#: children are pre-created so /metrics shows the full consensus-phase
+#: distribution from boot, not from first observation
+_CONSENSUS_PHASES = ("divide_rounds", "decide_fame", "find_order")
 
 
 class Node:
@@ -45,11 +51,19 @@ class Node:
         transport: Transport,
         proxy,
         engine: Optional[TpuHashgraph] = None,
+        registry: Optional[Registry] = None,
     ):
         self.conf = conf
         self.logger = conf.logger
         self.transport = transport
         self.proxy = proxy
+        # per-node telemetry: the registry backs /metrics (and the
+        # legacy /Stats timing keys), the tracer backs /debug/spans.
+        # Each node owns its own so in-process fleets (tests) don't
+        # cross streams; the node instruments its transport below so
+        # the wire-level series land on the same /metrics page.
+        self.registry = registry if registry is not None else Registry()
+        self.tracer = SpanTracer()
 
         participants = canonical_ids(peers)
         self.participants = participants
@@ -67,6 +81,7 @@ class Node:
             fork_caps=conf.fork_caps,
             wide=(getattr(conf, "engine", "fused") == "wide"),
             wide_caps=conf.wide_caps,
+            registry=self.registry,
         )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
@@ -84,17 +99,101 @@ class Node:
         self._consensus_task: Optional[asyncio.Task] = None
         self._consensus_dirty = False
 
-        # stats counters (the reference declares but never increments its
-        # sync counters, node.go:64-65; here they are real)
-        self.sync_requests = 0
-        self.sync_errors = 0
         self._last_consensus = 0.0
         self._fast_forwarding = False
         self.start_time = time.monotonic()
-        # last-gossip phase timings in ms (the reference logs ns durations
-        # per phase, node.go:166-255, core.go:180-196; here they are part
-        # of the stats schema so /Stats exposes them fleet-wide)
-        self.timings: Dict[str, float] = {}
+
+        # instruments (the reference declares but never increments its
+        # sync counters, node.go:64-65; here they are real registry
+        # counters, and the per-phase ns durations it only logs
+        # (node.go:166-255, core.go:180-196) are histograms whose last
+        # samples render the /Stats *_ms keys fleet-wide)
+        m = self.registry
+        self._m_sync_requests = m.counter(
+            "babble_sync_requests_total", "outbound gossip syncs attempted")
+        self._m_sync_errors = m.counter(
+            "babble_sync_errors_total", "outbound gossip syncs failed")
+        self._m_gossip_rtt = m.histogram(
+            "babble_gossip_rtt_seconds",
+            "sync RPC round-trip time (request sent to response parsed)")
+        self._m_gossip_events = m.counter(
+            "babble_gossip_events_received_total",
+            "events carried by applied sync responses")
+        self._m_ff_total = m.counter(
+            "babble_fast_forwards_total",
+            "snapshot catch-ups attempted after a too_late sync")
+        self._m_ff_seconds = m.histogram(
+            "babble_fast_forward_seconds",
+            "fast-forward fetch+validate+bootstrap wall time")
+        self._m_sync_seconds = m.histogram(
+            "babble_sync_seconds",
+            "insert+mint wall time per applied sync response")
+        self._m_consensus_seconds = m.histogram(
+            "babble_consensus_seconds",
+            "consensus pipeline wall time per run")
+        self._m_phase_seconds = m.histogram(
+            "babble_consensus_phase_seconds",
+            "per-phase consensus pipeline wall time",
+            labelnames=("phase",))
+        for phase in _CONSENSUS_PHASES:
+            self._m_phase_seconds.labels(phase)
+        self._m_submitted_tx = m.counter(
+            "babble_submitted_tx_total",
+            "transactions accepted into the pool from the app")
+        self._m_commit_tx = m.counter(
+            "babble_commit_tx_total", "transactions delivered to the app")
+        self._m_commit_retries = m.counter(
+            "babble_commit_retries_total", "commit_tx delivery retries")
+        self._m_commit_latency = m.histogram(
+            "babble_commit_latency_seconds",
+            "commit batch delivery wall time (dequeue to last app ack)")
+        # sampled at scrape time: no bookkeeping at the mutation sites
+        m.gauge(
+            "babble_commit_queue_depth",
+            "commit batches awaiting delivery to the app",
+        ).set_function(self._commit_queue.qsize)
+        m.gauge(
+            "babble_transaction_pool",
+            "transactions pooled for the next self-event",
+        ).set_function(lambda: len(self.transaction_pool))
+        m.gauge(
+            "babble_gossip_backoff_creators",
+            "creators under per-creator resync backoff (byzantine mode)",
+        ).set_function(lambda: len(self.core._creator_backoff))
+        self._loop_probe = LoopLagProbe(m)
+        # transport-level series (bytes in/out, pool reuse) land on the
+        # same /metrics page when the transport supports instrumentation
+        # (TCPTransport.instrument; in-memory test transports need not)
+        instrument = getattr(transport, "instrument", None)
+        if instrument is not None:
+            instrument(m)
+
+    # ------------------------------------------------------------------
+    # registry-backed mirrors of the legacy counters/dict
+
+    @property
+    def sync_requests(self) -> int:
+        return int(self._m_sync_requests.value)
+
+    @property
+    def sync_errors(self) -> int:
+        return int(self._m_sync_errors.value)
+
+    @property
+    def timings(self) -> Dict[str, float]:
+        """The legacy last-gossip timing map (ms), rendered from the
+        registry histograms' last samples — same /Stats keys as the
+        ad-hoc dict this replaces, keys appearing on first observation."""
+        out: Dict[str, float] = {}
+        if self._m_sync_seconds.count:
+            out["sync_ms"] = self._m_sync_seconds.last * 1e3
+        if self._m_consensus_seconds.count:
+            out["consensus_ms"] = self._m_consensus_seconds.last * 1e3
+        for phase in _CONSENSUS_PHASES:
+            h = self._m_phase_seconds.labels(phase)
+            if h.count:
+                out[f"{phase}_ms"] = h.last * 1e3
+        return out
 
     # ------------------------------------------------------------------
 
@@ -119,6 +218,9 @@ class Node:
         consumer = self.transport.consumer
         if self._committer is None:
             self._committer = asyncio.create_task(self._commit_loop())
+        # loop-lag probe: one histogram saying whether the event loop
+        # itself is starved (cancelled with the rest of _tasks)
+        self._tasks.append(self._loop_probe.start())
         if (gossip and self.conf.consensus_interval > 0
                 and self._consensus_task is None):
             self._consensus_task = asyncio.create_task(
@@ -154,6 +256,7 @@ class Node:
                 await self._process_rpc(get_rpc.result())
             if get_tx in done:
                 self.transaction_pool.append(get_tx.result())
+                self._m_submitted_tx.inc()
             if gossip and _time.monotonic() >= deadline:
                 # backpressure: never queue more in-flight syncs than the
                 # fleet can serve (Config.gossip_inflight)
@@ -255,18 +358,21 @@ class Node:
 
     async def _gossip(self, peer_addr: str) -> None:
         try:
-            async with self.core_lock:
-                known = self.core.known()
-            self.sync_requests += 1
-            resp = await self.transport.sync(
-                peer_addr,
-                SyncRequest(
-                    from_addr=self.transport.local_addr(), known=known
-                ),
-                timeout=self.conf.tcp_timeout,
-            )
-            await self._process_sync_response(resp)
-            self.peer_selector.update_last(peer_addr)
+            with self.tracer.span("gossip", peer=peer_addr):
+                async with self.core_lock:
+                    known = self.core.known()
+                self._m_sync_requests.inc()
+                t0 = time.perf_counter()
+                resp = await self.transport.sync(
+                    peer_addr,
+                    SyncRequest(
+                        from_addr=self.transport.local_addr(), known=known
+                    ),
+                    timeout=self.conf.tcp_timeout,
+                )
+                self._m_gossip_rtt.observe(time.perf_counter() - t0)
+                await self._process_sync_response(resp)
+                self.peer_selector.update_last(peer_addr)
         except asyncio.CancelledError:
             raise
         except TransportError as e:
@@ -279,10 +385,10 @@ class Node:
                     self.core.reset_gossip_backoff()
                 await self._fast_forward(peer_addr)
                 return
-            self.sync_errors += 1
+            self._m_sync_errors.inc()
             self.logger.warning("gossip to %s failed: %s", peer_addr, e)
         except Exception as e:  # any failure counts against sync_rate
-            self.sync_errors += 1
+            self._m_sync_errors.inc()
             self.logger.warning("gossip to %s failed: %s", peer_addr, e)
 
     def ff_max_caps(self) -> tuple:
@@ -359,6 +465,8 @@ class Node:
         if self._fast_forwarding:
             return
         self._fast_forwarding = True
+        self._m_ff_total.inc()
+        t_ff = time.perf_counter()
         try:
             resp = await self.transport.request(
                 peer_addr,
@@ -455,11 +563,14 @@ class Node:
                         "app fast-forward hook failed: %s", e
                     )
         except Exception as e:
-            self.sync_errors += 1
+            self._m_sync_errors.inc()
             self.logger.warning(
                 "fast-forward from %s failed: %s", peer_addr, e
             )
         finally:
+            dur = time.perf_counter() - t_ff
+            self._m_ff_seconds.observe(dur)
+            self.tracer.record("fast_forward", dur, peer=peer_addr)
             # deliberate re-entrancy flag: set before the awaits, checked
             # at entry, cleared in the finally — the check-then-set pair
             # has no await between them, so no second task can slip in
@@ -489,6 +600,10 @@ class Node:
                 self.transaction_pool = payload + self.transaction_pool
                 raise
             t1 = time.perf_counter()
+            self._m_sync_seconds.observe(t1 - t0)
+            self._m_gossip_events.inc(len(resp.events))
+            self.tracer.record("sync_apply", t1 - t0,
+                               events=len(resp.events))
             # Consensus cadence (Config.consensus_interval > 0): the
             # pipeline runs in its own task (_consensus_loop), OFF the
             # gossip critical path — an 8-17 ms device pipeline call in
@@ -498,35 +613,31 @@ class Node:
             # fleet gap).  interval <= 0 keeps the reference's
             # consensus-after-every-sync shape (node.go:224).
             if self.conf.consensus_interval > 0:
-                self.timings = {**self.timings, "sync_ms": (t1 - t0) * 1e3}
                 self._consensus_dirty = True
                 return
-            await self._run_consensus_locked(t0, t1, len(resp.events))
+            await self._run_consensus_locked(len(resp.events))
 
-    async def _run_consensus_locked(self, t0, t1, n_events) -> None:
+    async def _run_consensus_locked(self, n_events) -> None:
         """Run the consensus pipeline; caller holds the core lock."""
         loop = asyncio.get_running_loop()
         self._last_consensus = time.monotonic()
-        new_events, phase_timings = await loop.run_in_executor(
-            None, self.core.run_consensus
-        )
-        t2 = time.perf_counter()
-        sync_ms = (
-            (t1 - t0) * 1e3 if t1 > t0
-            else self.timings.get("sync_ms", 0.0)  # cadence path: keep last real sync
-        )
-        self.timings = {
-            "sync_ms": sync_ms,
-            "consensus_ms": (t2 - t1) * 1e3,
-            **{
-                k.replace("_s", "_ms"): v * 1e3
-                for k, v in phase_timings.items()
-            },
-        }
+        t1 = time.perf_counter()
+        # the span wraps the await so the device work dispatched to the
+        # worker thread is timed from the awaiting coroutine; phase
+        # records inside the span become its children in /debug/spans
+        with self.tracer.span("consensus", events=n_events):
+            new_events, phase_timings = await loop.run_in_executor(
+                None, self.core.run_consensus
+            )
+            t2 = time.perf_counter()
+            for k, v in phase_timings.items():
+                phase = k[: -len("_s")]
+                self._m_phase_seconds.labels(phase).observe(v)
+                self.tracer.record(phase, v)
+        self._m_consensus_seconds.observe(t2 - t1)
         self.logger.debug(
-            "sync %d events in %.1fms, consensus %.1fms",
-            n_events, self.timings["sync_ms"],
-            self.timings["consensus_ms"],
+            "sync %d events, consensus %.1fms",
+            n_events, (t2 - t1) * 1e3,
         )
         if new_events:
             # enqueue under the lock: batches reach the committer in
@@ -546,8 +657,7 @@ class Node:
             self._consensus_dirty = False
             try:
                 async with self.core_lock:
-                    t = time.perf_counter()
-                    await self._run_consensus_locked(t, t, 0)
+                    await self._run_consensus_locked(0)
             except asyncio.CancelledError:
                 raise
             except Exception as e:
@@ -560,16 +670,19 @@ class Node:
         dropping would silently break the app's state-machine ordering."""
         while True:
             events = await self._commit_queue.get()
+            t0 = time.perf_counter()
             for ev in events:
                 for tx in ev.transactions:
                     delay = 0.2
                     for attempt in range(8):
                         try:
                             await self.proxy.commit_tx(tx)
+                            self._m_commit_tx.inc()
                             break
                         except asyncio.CancelledError:
                             raise
                         except Exception as e:
+                            self._m_commit_retries.inc()
                             self.logger.warning(
                                 "commit_tx failed (attempt %d): %s",
                                 attempt + 1, e,
@@ -578,6 +691,9 @@ class Node:
                             delay = min(delay * 2, 3.0)
                     else:
                         self.logger.error("commit_tx dropped after retries")
+            dur = time.perf_counter() - t0
+            self._m_commit_latency.observe(dur)
+            self.tracer.record("commit_batch", dur, events=len(events))
 
     def _random_timeout(self) -> float:
         """Randomized heartbeat pacing (reference node.go:345-351:

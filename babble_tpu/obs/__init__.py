@@ -1,0 +1,44 @@
+"""Observability: metrics registry, span tracer, event-loop probe.
+
+Stdlib-only by contract — this package is imported by the analysis/CI
+layer and must work where jax and cryptography are absent.  Three
+pieces (ISSUE 2):
+
+- :mod:`.metrics` — Counter/Gauge/Histogram registry with
+  Prometheus-text exposition (served at ``/metrics`` by
+  ``service.Service``), safe from the event loop and the worker
+  threads that drive the device pipeline.
+- :mod:`.spans` — bounded-ring span tracer with a context-manager /
+  decorator API; parent/child wall-clock trees for a full
+  submit→gossip→device-step→commit cycle (served at ``/debug/spans``).
+- :mod:`.probe` — asyncio event-loop-lag probe (one histogram saying
+  whether the loop itself is starved).
+
+Each :class:`~babble_tpu.node.node.Node` owns one ``Registry`` + one
+``SpanTracer``; fleet-wide collection is a ``/metrics`` sweep
+(``fleet.scrape_hosts`` / ``babble-tpu fleet scrape``).
+"""
+
+from .metrics import (
+    LATENCY_BUCKETS,
+    SIZE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricFamily,
+    Registry,
+)
+from .probe import LoopLagProbe
+from .spans import SpanTracer
+
+__all__ = [
+    "LATENCY_BUCKETS",
+    "SIZE_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricFamily",
+    "Registry",
+    "LoopLagProbe",
+    "SpanTracer",
+]

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import Registry
 from .ingest import EventBatch
 from .state import (
     DagConfig,
@@ -86,7 +87,7 @@ class WideStream:
     def __init__(self, cfg: DagConfig, n_blocks: Optional[int] = None,
                  round_margin: int = 0, seq_window: int = 64,
                  record_ordered: bool = True, stacked: bool = False,
-                 mesh=None):
+                 mesh=None, registry: Optional[Registry] = None):
         """``stacked=True`` holds la/fd as one [C, E+1, w] array driven
         by the vmapped stacked kernels; with ``mesh`` (an axis named
         "p") the block axis is sharded across devices and the cross-
@@ -114,14 +115,24 @@ class WideStream:
         self.ordered: dict = {}         # global slot -> (rr, cts) if recorded
         self.stats: dict = {"n_blocks": self.C}
         self.timings: dict = {}
+        # per-stage registry histograms beside the cumulative dict: the
+        # dict feeds bench roofline accounting (totals), the histograms
+        # give /metrics the per-call device-time DISTRIBUTION the dict
+        # never exported (ISSUE 2 satellite)
+        self.registry = Registry() if registry is None else registry
+        self._m_stage = self.registry.histogram(
+            "babble_wide_stage_seconds",
+            "wide-pipeline stage wall time per call",
+            labelnames=("stage",),
+        )
         self._rr_seen = np.zeros((cfg.e_cap + 1,), bool)  # window rows
 
     # ------------------------------------------------------------------
 
     def _tick(self, name: str, t0: float) -> None:
-        self.timings[name] = (
-            self.timings.get(name, 0.0) + time.perf_counter() - t0
-        )
+        dt = time.perf_counter() - t0
+        self.timings[name] = self.timings.get(name, 0.0) + dt
+        self._m_stage.labels(name).observe(dt)
 
     @property
     def n_live(self) -> int:
@@ -368,6 +379,7 @@ def stream_consensus(
     stacked: bool = False,
     mesh=None,
     deadline_s: Optional[float] = None,
+    registry: Optional[Registry] = None,
 ) -> WideStream:
     """Stream an ArrayDag (sim.arrays) through a rolling window:
     ingest -> consensus -> compact per mega-batch of ~batch_events.
@@ -379,7 +391,7 @@ def stream_consensus(
     stream = WideStream(cfg, n_blocks=n_blocks,
                         round_margin=round_margin, seq_window=seq_window,
                         record_ordered=record_ordered, stacked=stacked,
-                        mesh=mesh)
+                        mesh=mesh, registry=registry)
     E = dag.n_events
     # suffix-min of parent slots: the eviction bound for "no future
     # batch references below here"

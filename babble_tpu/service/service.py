@@ -1,7 +1,19 @@
-"""HTTP /Stats + /debug endpoints (reference service/service.go:26-58).
+"""HTTP /Stats + /metrics + /debug endpoints (service/service.go:26-58).
 
 A minimal asyncio HTTP server living in the node's event loop, returning
 ``node.get_stats()`` as JSON with the reference's stat-key schema.
+
+Beyond the reference's flat string map, the node's telemetry registry
+(babble_tpu/obs, ISSUE 2) is exposed machine-scrapably:
+
+- ``/metrics``      — Prometheus text exposition (version 0.0.4) of the
+  node's metric registry: counters, gauges, and the latency/size
+  histograms behind the /Stats ``*_ms`` keys.  Read-only, same trust
+  level as /Stats, so not loopback-gated.
+- ``/debug/spans``  — the span tracer's bounded ring as parent/child
+  wall-clock trees (one tree per gossip/consensus/commit cycle), plus
+  the drop counter so truncation is distinguishable from quiescence.
+  Loopback-gated like the other /debug endpoints.
 
 The reference piggy-backs Go pprof on the same listener (cmd/main.go:26,
 ``import _ "net/http/pprof"``); the equivalents here are the profilers
@@ -58,6 +70,17 @@ class Service:
         if not seconds == seconds:   # NaN (incl. unparsable input)
             return b"bad seconds parameter", "400 Bad Request", "text/plain"
         seconds = min(max(seconds, 0.1), 120.0)
+        if path == "/debug/spans":
+            tracer = getattr(self.node, "tracer", None)
+            if tracer is None:
+                return (b'{"error": "node has no span tracer"}',
+                        "404 Not Found", "application/json")
+            body = json.dumps({
+                "capacity": tracer.capacity,
+                "dropped": tracer.dropped,
+                "trees": tracer.trees(),
+            })
+            return body.encode(), "200 OK", "application/json"
         if path == "/debug/stack":
             import sys
             import threading
@@ -140,6 +163,15 @@ class Service:
         if path.lower() == "/stats":
             body = json.dumps(self.node.get_stats()).encode()
             status = "200 OK"
+        elif path == "/metrics":
+            registry = getattr(self.node, "registry", None)
+            if registry is None:
+                body = b'{"error": "node has no metrics registry"}'
+                status = "404 Not Found"
+            else:
+                body = registry.exposition().encode()
+                status = "200 OK"
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path.startswith("/debug/"):
             peer = writer.get_extra_info("peername")
             peer_ip = peer[0] if peer else ""

@@ -176,6 +176,14 @@ def fetch_stats(service_addr: str, timeout: float = 3.0) -> Dict[str, str]:
         return json.load(r)
 
 
+def fetch_metrics(service_addr: str, timeout: float = 3.0) -> str:
+    """One node's Prometheus text exposition (service /metrics)."""
+    with urllib.request.urlopen(
+        f"http://{service_addr}/metrics", timeout=timeout
+    ) as r:
+        return r.read().decode("utf-8", errors="replace")
+
+
 def watch_once(n: int, ports: Optional[PortLayout] = None) -> List[Dict[str, str]]:
     """One /Stats sweep across the fleet (reference docker/scripts/watch.sh)."""
     ports = ports or PortLayout()

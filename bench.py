@@ -681,6 +681,22 @@ def run_live(n: int = 4, measure_s: float = 30.0) -> dict:
             out["vs_reference_testnet"] = round(
                 out["events_per_sec_gossip"] / 264.65, 2
             )
+        # ISSUE 2: the artifact carries its own telemetry evidence — a
+        # /metrics sweep of every node at the end of the measured
+        # window, so a degraded round is attributable (phase/RTT/commit
+        # histograms) without re-running anything
+        mtexts = []
+        for i in range(n):
+            try:
+                mtexts.append(tn.fetch_metrics(ports.of(i)["service"]))
+            except (OSError, ValueError, tn.HTTPException) as e:
+                mtexts.append(f"# scrape failed: {e}\n")
+        out["metrics"] = mtexts
+        out["metrics_series"] = [
+            sum(1 for ln in t.splitlines()
+                if ln and not ln.startswith("#"))
+            for t in mtexts
+        ]
     import shutil
 
     shutil.rmtree(tmp, ignore_errors=True)   # node datadirs, keys, logs
@@ -873,6 +889,7 @@ def run_10k(n: int = 10_000, e: int = 1_000_000,
     bit-parity at small shapes with forced blocking + compaction."""
     import numpy as np
 
+    from babble_tpu.obs import Registry
     from babble_tpu.ops.state import DagConfig
     from babble_tpu.ops.stream import stream_consensus
 
@@ -897,10 +914,12 @@ def run_10k(n: int = 10_000, e: int = 1_000_000,
     # 2% of peak in r3) — bit-parity-pinned vs the tuple path by
     # tests/test_stream.py; opt-in until TPU-measured at this scale
     stacked = os.environ.get("BENCH_10K_STACKED") == "1"
+    registry = Registry()   # per-stage distributions ride the artifact
     stream = stream_consensus(
         cfg, dag, batch_events=batch, round_margin=0, seq_window=48,
         compact_min=4096, record_ordered=False, log=log,
         deadline_s=max(120.0, remaining() - 90.0), stacked=stacked,
+        registry=registry,
     )
     total = time.perf_counter() - t0
     rtf = stream.stats.get("fame_decision_distance", {})
@@ -931,6 +950,9 @@ def run_10k(n: int = 10_000, e: int = 1_000_000,
         },
         "stats": {k: v for k, v in stream.stats.items()
                   if k != "fame_decision_distance"},
+        # registry snapshot (ISSUE 2): per-stage wall-time histograms —
+        # the distribution evidence the cumulative phase_s totals lack
+        "metrics": registry.snapshot(),
     }
     log(f"[{tag}] total {total:.1f}s; ordered {stream.ordered_total}/{e} "
         f"(lcr {stream.lcr}, max_round {detail['max_round']}); "

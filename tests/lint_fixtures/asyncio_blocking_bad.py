@@ -1,0 +1,45 @@
+"""Fixture: blocking calls inside coroutines — each stalls the whole
+event loop for its duration (asyncio-blocking-call)."""
+
+import socket
+import time
+import urllib.request
+
+import asyncio
+
+
+class Gossiper:
+    async def heartbeat(self):
+        time.sleep(0.5)  # MARK: asyncio-blocking-call
+        await asyncio.sleep(0.5)  # clean: the asyncio form
+
+    async def dial(self, host, port):
+        conn = socket.create_connection((host, port))  # MARK: asyncio-blocking-call
+        return conn
+
+    async def resolve(self, host):
+        return socket.getaddrinfo(host, 80)  # MARK: asyncio-blocking-call
+
+    async def fetch(self, url):
+        return urllib.request.urlopen(url)  # MARK: asyncio-blocking-call
+
+    async def read_from(self, sock):
+        return sock.recv(4096)  # MARK: asyncio-blocking-call
+
+    async def push(self, writer, data):
+        # clean: `writer` is not sock-ish — StreamWriter-style send
+        # helpers must not be flagged by the name heuristic
+        writer.send(data)
+
+    async def offload(self, loop, sock):
+        # clean: the blocking work lives in a nested sync closure that
+        # run_in_executor drives off-loop — the correct pattern
+        def work():
+            time.sleep(0.1)
+            return sock.recv(4096)
+
+        return await loop.run_in_executor(None, work)
+
+    def sync_path(self):
+        # clean: not a coroutine — sync CLI paths may sleep
+        time.sleep(0.1)

@@ -3,10 +3,14 @@
 import json
 import os
 import stat
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
 
 from babble_tpu.fleet import (
     HostLayout,
     build_fleet_conf,
+    scrape_hosts,
+    watch_hosts,
     write_deploy_scripts,
 )
 
@@ -54,3 +58,120 @@ def test_fleet_conf_idempotent(tmp_path):
     first = open(os.path.join(base, "node0", "peers.json")).read()
     build_fleet_conf(base, layout)
     assert open(os.path.join(base, "node0", "peers.json")).read() == first
+
+
+# ----------------------------------------------------------------------
+# /Stats watch + /metrics scrape sweeps (ISSUE 2)
+
+_METRICS_TEXT = (
+    "# HELP babble_sync_requests_total syncs\n"
+    "# TYPE babble_sync_requests_total counter\n"
+    "babble_sync_requests_total 3\n"
+)
+
+
+class _FleetStub(BaseHTTPRequestHandler):
+    """One fake fleet host: valid /metrics, GARBAGE /Stats body."""
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body, ctype = _METRICS_TEXT.encode(), "text/plain"
+        elif self.path == "/Stats":
+            body, ctype = b"<html>not json</html>", "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+class _ErrorStub(BaseHTTPRequestHandler):
+    """A host that ANSWERS, with an HTTP error (a 500ing service, or a
+    pre-telemetry binary 404ing /metrics)."""
+
+    def do_GET(self):
+        self.send_error(500)
+
+    def log_message(self, *a):
+        pass
+
+
+def _stub_server(handler=_FleetStub):
+    srv = HTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    return srv
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_watch_hosts_distinguishes_unreachable_from_malformed():
+    """ISSUE 2 satellite: 'host down' (networking) and 'host answered
+    garbage' (broken service) are different operator problems — the
+    sweep row says which one it saw."""
+    srv = _stub_server()
+    try:
+        # malformed: the stub answers /Stats with non-JSON
+        rows = watch_hosts(
+            HostLayout(["127.0.0.1"], service_port=srv.server_port)
+        )
+        assert rows[0]["kind"] == "malformed", rows
+        assert rows[0]["host"].endswith(str(srv.server_port))
+        assert "error" in rows[0]
+        # unreachable: nothing listens on this port
+        rows = watch_hosts(
+            HostLayout(["127.0.0.1"], service_port=_free_port())
+        )
+        assert rows[0]["kind"] == "unreachable", rows
+        assert "error" in rows[0] and rows[0]["id"] == "0"
+    finally:
+        srv.shutdown()
+    # an HTTP error status is MALFORMED, not unreachable: the host
+    # answered (urllib.error.HTTPError is an OSError subclass — the
+    # classification must not let isinstance ordering flip it)
+    err = _stub_server(_ErrorStub)
+    try:
+        rows = watch_hosts(
+            HostLayout(["127.0.0.1"], service_port=err.server_port)
+        )
+        assert rows[0]["kind"] == "malformed", rows
+    finally:
+        err.shutdown()
+
+
+def test_scrape_hosts_returns_metrics_text_and_failure_kinds():
+    srv = _stub_server()
+    try:
+        rows = scrape_hosts(
+            HostLayout(["127.0.0.1"], service_port=srv.server_port)
+        )
+        assert rows[0]["metrics"] == _METRICS_TEXT
+        rows = scrape_hosts(
+            HostLayout(["127.0.0.1"], service_port=_free_port())
+        )
+        assert rows[0]["kind"] == "unreachable"
+        assert "metrics" not in rows[0]
+    finally:
+        srv.shutdown()
+    err = _stub_server(_ErrorStub)
+    try:
+        rows = scrape_hosts(
+            HostLayout(["127.0.0.1"], service_port=err.server_port)
+        )
+        assert rows[0]["kind"] == "malformed", rows
+    finally:
+        err.shutdown()

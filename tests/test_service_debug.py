@@ -1,0 +1,212 @@
+"""Service endpoint coverage (ISSUE 2 satellites): /metrics exposition
+on a live node, /debug/spans, loopback gating of /debug, NaN `seconds`
+rejection, and /debug/trace tempdir retention.
+
+The gating/NaN/retention tests drive ``Service._handle`` directly with
+fake reader/writer pairs so a non-loopback peer can be simulated
+without real remote sockets.
+"""
+
+import asyncio
+import json
+import os
+
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.net import InmemNetwork, Peer
+from babble_tpu.node import Config, Node
+from babble_tpu.proxy.inmem import InmemAppProxy
+from babble_tpu.service.service import _MAX_TRACE_DIRS, Service
+
+
+def _make_node():
+    net = InmemNetwork()
+    key = generate_key()
+    t = net.transport()
+    peers = [Peer(net_addr=t.local_addr(), pub_key_hex=key.pub_hex)]
+    node = Node(Config.test_config(), key, peers, t, InmemAppProxy())
+    node.init()
+    return node
+
+
+class _FakeReader:
+    def __init__(self, request_line):
+        self._lines = [request_line, b"\r\n"]
+
+    async def readline(self):
+        return self._lines.pop(0) if self._lines else b""
+
+
+class _FakeWriter:
+    def __init__(self, peer):
+        self._peer = peer
+        self.data = b""
+
+    def get_extra_info(self, key):
+        return self._peer
+
+    def write(self, b):
+        self.data += b
+
+    async def drain(self):
+        pass
+
+
+async def _request(svc, path, peer=("127.0.0.1", 40000)):
+    w = _FakeWriter(peer)
+    await svc._handle(_FakeReader(f"GET {path} HTTP/1.1\r\n".encode()), w)
+    head, _, body = w.data.partition(b"\r\n\r\n")
+    status = head.split(b"\r\n")[0].split(b" ", 1)[1].decode()
+    return status, body
+
+
+# ----------------------------------------------------------------------
+# /metrics + /debug/spans (the tentpole surface)
+
+
+def test_metrics_endpoint_on_live_node():
+    """Acceptance criterion: /metrics answers Prometheus text with >= 20
+    series including the consensus-phase and gossip-RTT histograms,
+    while /Stats keeps the reference schema untouched."""
+    import urllib.request
+
+    async def go():
+        node = _make_node()
+        svc = Service("127.0.0.1:0", node)
+        await svc.start()
+        base = f"http://{svc.bind_addr}"
+        loop = asyncio.get_running_loop()
+
+        def get(url):
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.status, dict(r.headers), r.read()
+
+        st, headers, body = await loop.run_in_executor(
+            None, get, base + "/metrics"
+        )
+        assert st == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        text = body.decode()
+        series = [ln for ln in text.splitlines()
+                  if ln and not ln.startswith("#")]
+        assert len(series) >= 20, f"only {len(series)} series"
+        assert "babble_consensus_phase_seconds_bucket" in text
+        assert "babble_gossip_rtt_seconds_bucket" in text
+        assert "babble_sync_requests_total" in text
+        # /Stats stays byte-compatible with the reference key schema
+        st, _, body = await loop.run_in_executor(None, get, base + "/Stats")
+        stats = json.loads(body)
+        for k in ("last_consensus_round", "consensus_events", "sync_rate",
+                  "events_per_second", "transaction_pool", "id"):
+            assert k in stats, k
+        await svc.close()
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+def test_debug_spans_endpoint():
+    async def go():
+        node = _make_node()
+        with node.tracer.span("gossip", peer="x"):
+            node.tracer.record("sync_apply", 0.002, events=3)
+        svc = Service("127.0.0.1:0", node)
+        status, body = await _request(svc, "/debug/spans")
+        assert status == "200 OK"
+        dump = json.loads(body)
+        assert dump["dropped"] == 0
+        (tree,) = dump["trees"]
+        assert tree["name"] == "gossip"
+        assert [c["name"] for c in tree["children"]] == ["sync_apply"]
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+# ----------------------------------------------------------------------
+# /debug gating + parameter validation (ISSUE 2 satellite)
+
+
+def test_debug_is_loopback_only_by_default():
+    async def go():
+        node = _make_node()
+        svc = Service("127.0.0.1:0", node)
+        for path in ("/debug/stack", "/debug/spans"):
+            status, body = await _request(
+                svc, path, peer=("10.1.2.3", 5555)
+            )
+            assert status == "403 Forbidden", (path, status)
+            assert b"loopback" in body
+        # an absent peername (unix-socket-ish) is NOT local
+        status, _ = await _request(svc, "/debug/stack", peer=None)
+        assert status == "403 Forbidden"
+        # loopback callers pass
+        status, _ = await _request(svc, "/debug/stack")
+        assert status == "200 OK"
+        # /metrics and /Stats are read-only scrape surfaces: not gated
+        status, _ = await _request(svc, "/metrics", peer=("10.1.2.3", 1))
+        assert status == "200 OK"
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+def test_allow_remote_debug_opens_the_gate():
+    async def go():
+        node = _make_node()
+        svc = Service("127.0.0.1:0", node, allow_remote_debug=True)
+        status, _ = await _request(
+            svc, "/debug/stack", peer=("10.1.2.3", 5555)
+        )
+        assert status == "200 OK"
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+def test_debug_rejects_nan_and_garbage_seconds():
+    async def go():
+        node = _make_node()
+        svc = Service("127.0.0.1:0", node)
+        # an EMPTY seconds= is dropped by parse_qs and falls back to
+        # the default — only NaN/unparsable values are rejected
+        for q in ("nan", "NaN", "abc"):
+            status, body = await _request(
+                svc, f"/debug/profile?seconds={q}"
+            )
+            assert status == "400 Bad Request", (q, status)
+            assert b"bad seconds" in body
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+def test_trace_tempdir_retention(monkeypatch):
+    """Repeated /debug/trace calls must not accumulate unbounded disk:
+    only the newest _MAX_TRACE_DIRS tempdirs survive, older ones are
+    deleted from disk."""
+    import jax
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+
+    async def go():
+        node = _make_node()
+        svc = Service("127.0.0.1:0", node)
+        dirs = []
+        for _ in range(_MAX_TRACE_DIRS + 3):
+            status, body = await _request(svc, "/debug/trace?seconds=0.1")
+            assert status == "200 OK", status
+            dirs.append(json.loads(body)["trace_dir"])
+        assert len(svc._trace_dirs) == _MAX_TRACE_DIRS
+        survivors = dirs[-_MAX_TRACE_DIRS:]
+        assert svc._trace_dirs == survivors
+        for d in survivors:
+            assert os.path.isdir(d), d
+        for d in dirs[:-_MAX_TRACE_DIRS]:
+            assert not os.path.exists(d), d
+        await svc.close()   # close() reaps the survivors too
+        for d in survivors:
+            assert not os.path.exists(d), d
+        await node.shutdown()
+
+    asyncio.run(go())

@@ -59,10 +59,10 @@ def test_rule_catalog_well_formed():
         assert r.name and r.name == r.name.lower(), r.name
         assert " " not in r.name, f"rule name {r.name!r} is not a slug"
         assert r.description, f"rule {r.name} has no description"
-    # the four ISSUE-1 rule families are all represented
+    # the ISSUE-1 rule families plus the ISSUE-2 blocking-call rule
     assert {"jit-traced-branch", "jit-host-sync", "jit-unhashable-static",
-            "await-state-race", "drain-before-validate",
-            "falsy-or-fallback"} <= set(names)
+            "await-state-race", "asyncio-blocking-call",
+            "drain-before-validate", "falsy-or-fallback"} <= set(names)
 
 
 def test_every_suppression_in_tree_names_a_rule():
@@ -121,6 +121,19 @@ def test_races_fixture_findings():
     assert len(findings) == 2
 
 
+def test_blocking_fixture_findings():
+    """ISSUE 2 satellite: time.sleep and blocking-socket calls inside
+    async def are flagged; sync functions, non-sock receivers and
+    executor-bound nested closures are not."""
+    path = _fixture("asyncio_blocking_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "asyncio-blocking-call") == _marked_lines(
+        path, "asyncio-blocking-call"
+    ), [f.format() for f in findings]
+    # nothing else fires: the clean variants stay clean
+    assert len(findings) == 5, [f.format() for f in findings]
+
+
 def test_invariants_fixture_findings():
     path = _fixture("invariants_bad.py")
     findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
@@ -167,7 +180,8 @@ def test_cli_exits_nonzero_with_locations_on_fixtures():
     # findings carry file:line anchors for every family
     for rule in ("jit-traced-branch", "jit-host-sync",
                  "jit-unhashable-static", "await-state-race",
-                 "drain-before-validate", "falsy-or-fallback"):
+                 "asyncio-blocking-call", "drain-before-validate",
+                 "falsy-or-fallback"):
         assert rule in proc.stdout, (rule, proc.stdout)
     import re
 
