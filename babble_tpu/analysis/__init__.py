@@ -30,6 +30,7 @@ from .engine import (
 from .blocking import AsyncioBlockingCallRule
 from .invariants import DrainBeforeValidateRule, FalsyOrFallbackRule
 from .races import AwaitStateRaceRule
+from .randomness import ChaosUnseededRandomRule
 from .tracer import (
     JitHostSyncRule,
     JitTracedBranchRule,
@@ -42,6 +43,7 @@ ALL_RULES = [
     JitUnhashableStaticRule(),
     AwaitStateRaceRule(),
     AsyncioBlockingCallRule(),
+    ChaosUnseededRandomRule(),
     DrainBeforeValidateRule(),
     FalsyOrFallbackRule(),
 ]
@@ -60,6 +62,7 @@ __all__ = [
     "run_paths",
     "AsyncioBlockingCallRule",
     "AwaitStateRaceRule",
+    "ChaosUnseededRandomRule",
     "DrainBeforeValidateRule",
     "FalsyOrFallbackRule",
     "JitHostSyncRule",

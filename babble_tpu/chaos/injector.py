@@ -1,0 +1,165 @@
+"""Seeded fault injector: turns a (FaultPlan, seed) pair into decisions.
+
+Every random draw comes from a **per-directed-link child RNG** derived
+from the seed (``random.Random(f"{seed}:{src}>{dst}")``), never from the
+process-global RNG: the k-th sync attempt on a given link sees the same
+fault decision in every run, regardless of how syncs on other links
+interleave.  That is the property the acceptance test pins — the fault
+schedule is a pure function of (plan, seed, per-link attempt ordinal).
+
+The injector is clock-agnostic: the deterministic scenario runner
+advances ticks manually (:meth:`advance_to`), the live node path
+installs a wall-clock tick callback.  Schedule state (partitions) is
+read at decision time from whichever clock is installed.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .plan import FaultPlan
+
+#: fault kinds as exposed on babble_chaos_faults_total{kind=...}
+FAULT_KINDS = (
+    "drop", "delay", "duplicate", "reorder", "partition", "stale_replay",
+)
+
+
+@dataclass(frozen=True)
+class OutboundFaults:
+    """Concrete decisions for one outbound sync attempt."""
+
+    drop: bool = False
+    delay_s: float = 0.0
+    duplicate: bool = False
+    reorder_s: float = 0.0
+
+
+class FaultInjector:
+    def __init__(
+        self,
+        plan: FaultPlan,
+        seed: int,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.plan = plan
+        self.seed = seed
+        self._clock = clock
+        self._tick = 0.0
+        self._rngs: Dict[Tuple[int, int], random.Random] = {}
+        self._node_rngs: Dict[int, random.Random] = {}
+        self._link_seq: Dict[Tuple[int, int], int] = {}
+        #: decision log — only fired faults are recorded; ``seq`` is the
+        #: per-link attempt ordinal, so sorting by (src, dst, seq) gives
+        #: a canonical schedule independent of global interleaving
+        self.log: List[dict] = []
+        #: faults are suppressed while quiesced (the settle phase at the
+        #: end of a deterministic run: "the network eventually behaves")
+        self.quiesce = False
+
+    # ------------------------------------------------------------------
+    # clock
+
+    @property
+    def tick(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        return self._tick
+
+    def advance_to(self, tick: float) -> None:
+        self._tick = float(tick)
+
+    # ------------------------------------------------------------------
+    # seeded streams
+
+    def _rng(self, src: int, dst: int) -> random.Random:
+        rng = self._rngs.get((src, dst))
+        if rng is None:
+            # string seeding is content-based (not hash()-based), so the
+            # stream is stable across processes and PYTHONHASHSEED
+            rng = self._rngs[(src, dst)] = random.Random(
+                f"babble-chaos:{self.seed}:{src}>{dst}"
+            )
+        return rng
+
+    def node_rng(self, node: int) -> random.Random:
+        rng = self._node_rngs.get(node)
+        if rng is None:
+            rng = self._node_rngs[node] = random.Random(
+                f"babble-chaos:{self.seed}:node:{node}"
+            )
+        return rng
+
+    # ------------------------------------------------------------------
+    # decisions
+
+    def record(self, kind: str, src: int, dst: int, **extra) -> dict:
+        seq = self._link_seq.get((src, dst), 0)
+        entry = {"kind": kind, "src": src, "dst": dst,
+                 "tick": self.tick, "seq": seq, **extra}
+        self.log.append(entry)
+        return entry
+
+    def link_blocked(self, src: int, dst: int) -> bool:
+        if self.quiesce:
+            return False
+        return self.plan.partitioned(src, dst, self.tick)
+
+    def outbound(self, src: int, dst: int) -> OutboundFaults:
+        """Draw the fault decisions for one sync attempt src -> dst.
+        Quiesced attempts draw nothing, so the faulted portion of the
+        per-link stream stays aligned with its attempt count."""
+        if self.quiesce:
+            return OutboundFaults()
+        f = self.plan.link(src, dst)
+        rng = self._rng(src, dst)
+        self._link_seq[(src, dst)] = self._link_seq.get((src, dst), 0) + 1
+        if f.drop and rng.random() < f.drop:
+            self.record("drop", src, dst)
+            return OutboundFaults(drop=True)
+        delay_s = 0.0
+        if f.delay and rng.random() < f.delay:
+            delay_s = rng.uniform(*f.delay_ms) / 1e3
+            self.record("delay", src, dst, ms=round(delay_s * 1e3, 3))
+        duplicate = bool(f.duplicate and rng.random() < f.duplicate)
+        if duplicate:
+            self.record("duplicate", src, dst)
+        reorder_s = 0.0
+        if f.reorder and rng.random() < f.reorder:
+            reorder_s = rng.uniform(*f.reorder_ms) / 1e3
+            self.record("reorder", src, dst, ms=round(reorder_s * 1e3, 3))
+        return OutboundFaults(drop=False, delay_s=delay_s,
+                              duplicate=duplicate, reorder_s=reorder_s)
+
+    # ------------------------------------------------------------------
+    # byzantine
+
+    def is_stale_replayer(self, node: int) -> bool:
+        b = self.plan.byzantine
+        return (b is not None and b.mode == "stale_replay"
+                and b.node == node)
+
+    def stale_replay(self, node: int) -> bool:
+        """Should this inbound sync be answered with a stale cached
+        response?  Only for the configured stale-replay actor, only
+        once its activation tick passed."""
+        if self.quiesce or not self.is_stale_replayer(node):
+            return False
+        b = self.plan.byzantine
+        if self.tick < b.at:
+            return False
+        return self.node_rng(node).random() < b.prob
+
+    def stale_pick(self, node: int, n_cached: int) -> int:
+        return self.node_rng(node).randrange(n_cached)
+
+    # ------------------------------------------------------------------
+
+    def schedule_fingerprint(self) -> List[tuple]:
+        """Canonical fault schedule: (src, dst, seq, kind) sorted — the
+        reproducibility tests compare this across runs."""
+        return sorted(
+            (e["src"], e["dst"], e["seq"], e["kind"]) for e in self.log
+        )

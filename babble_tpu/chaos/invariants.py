@@ -1,0 +1,250 @@
+"""Consensus invariants the chaos plane checks after every scenario.
+
+Safety and liveness in the hashgraph sense, stated over what a scenario
+run actually observed:
+
+- **prefix_agreement** (safety): every pair of honest nodes committed
+  the same transaction order — the shorter log is a prefix of the
+  longer.  A node that crashed and restarted re-joins mid-stream (its
+  pre-restart deliveries happened in a previous incarnation, and a
+  fast-forward legitimately skips a gap), so its log must instead be a
+  contiguous sublist of the longest honest log.
+- **liveness**: consensus resumes after the network heals — every
+  honest live node's consensus-event count strictly increases between
+  the last heal/restart tick and ``liveness_bound`` ticks later.
+- **all_committed**: every submitted transaction appears in every
+  honest non-restarted node's committed log (checked after the settle
+  phase, when the network has been allowed to behave).
+- **fork_detected**: every honest node flagged the byzantine creator's
+  equivocation.  This is the invariant the intentionally-broken
+  fork-attack variant (fork detection disabled — ``engine: fused``)
+  fails loudly, which is exactly the point: without the fork-aware
+  engine the attack is invisible.
+- **fast_forwarded**: a crashed-and-restarted node caught back up via
+  the snapshot RPC (at least one fast-forward completed).
+
+The checker never raises mid-collection: it gathers every violation and
+reports them all, because a scenario that breaks two invariants at once
+is exactly the run you want the full picture of.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+
+@dataclass(frozen=True)
+class Violation:
+    invariant: str
+    detail: str
+
+    def format(self) -> str:
+        return f"INVARIANT VIOLATION [{self.invariant}]: {self.detail}"
+
+
+@dataclass
+class InvariantReport:
+    checked: List[str] = field(default_factory=list)
+    violations: List[Violation] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def format(self) -> str:
+        lines = [f"invariants checked: {', '.join(self.checked) or '(none)'}"]
+        if self.ok:
+            lines.append("all invariants hold")
+        else:
+            lines.extend(v.format() for v in self.violations)
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "checked": list(self.checked),
+            "violations": [
+                {"invariant": v.invariant, "detail": v.detail}
+                for v in self.violations
+            ],
+        }
+
+
+def _is_prefix(a: Sequence, b: Sequence) -> bool:
+    small, big = (a, b) if len(a) <= len(b) else (b, a)
+    return list(big[: len(small)]) == list(small)
+
+
+def _is_contiguous_sublist(small: Sequence, big: Sequence) -> bool:
+    if not small:
+        return True
+    small, big = list(small), list(big)
+    first = small[0]
+    start = 0
+    while True:
+        try:
+            i = big.index(first, start)
+        except ValueError:
+            return False
+        if big[i: i + len(small)] == small:
+            return True
+        start = i + 1
+
+
+def _windows_agree(a: Sequence, b: Sequence) -> bool:
+    """Two rolling *windows* of one logical sequence agree iff their
+    overlap matches (either may have evicted a prefix the other still
+    holds, and either may extend further).  Disjoint windows cannot be
+    falsified and count as agreement."""
+    a, b = list(a), list(b)
+    if not a or not b:
+        return True
+    for small, big in ((a, b), (b, a)):
+        if small[0] in big:
+            i = big.index(small[0])
+            n = min(len(small), len(big) - i)
+            if big[i: i + n] == small[:n]:
+                return True
+    # no shared elements at all: windows over disjoint ranges of the
+    # log cannot be falsified.  Any shared element with misaligned
+    # heads, though, is a real disagreement.
+    return not (set(a) & set(b))
+
+
+class InvariantChecker:
+    """Checks a ScenarioResult (scenario.py) against the scenario's
+    declared invariant list."""
+
+    def check(self, scenario, result) -> InvariantReport:
+        report = InvariantReport(checked=list(scenario.invariants))
+        for name in scenario.invariants:
+            getattr(self, f"_check_{name}")(scenario, result, report)
+        return report
+
+    # ------------------------------------------------------------------
+
+    def _check_prefix_agreement(self, scenario, result, report) -> None:
+        # a crashed-for-good node has no final log to compare (the plan
+        # explicitly supports restart=None) — agreement is checked over
+        # the nodes that finished the run
+        present = [i for i in result.honest if i in result.committed]
+        honest = [i for i in present if i not in result.restarted]
+        logs = {i: result.committed[i] for i in present}
+        if honest:
+            ref = max(honest, key=lambda i: len(logs[i]))
+            for i in honest:
+                if i == ref:
+                    continue
+                if not _is_prefix(logs[i], logs[ref]):
+                    k = next(
+                        (j for j, (x, y) in enumerate(zip(logs[i], logs[ref]))
+                         if x != y),
+                        min(len(logs[i]), len(logs[ref])),
+                    )
+                    report.violations.append(Violation(
+                        "prefix_agreement",
+                        f"nodes {i} and {ref} diverge at commit #{k}: "
+                        f"{logs[i][k:k + 1]} vs {logs[ref][k:k + 1]}",
+                    ))
+            for i in sorted(result.restarted):
+                if i not in result.honest or i not in logs:
+                    continue
+                if not _is_contiguous_sublist(logs[i], logs[ref]):
+                    report.violations.append(Violation(
+                        "prefix_agreement",
+                        f"restarted node {i}'s committed log is not a "
+                        f"contiguous slice of node {ref}'s "
+                        f"({len(logs[i])} vs {len(logs[ref])} commits)",
+                    ))
+        # consensus event order must agree too (stronger than tx order:
+        # empty events count).  Engines expose a rolling *window* of the
+        # consensus log (the evicted prefix is gone), so agreement is
+        # checked on the overlap, not as a strict prefix.
+        events = {i: result.consensus[i] for i in honest}
+        if len(events) > 1:
+            ref = max(events, key=lambda i: len(events[i]))
+            for i in events:
+                if i != ref and not _windows_agree(events[i], events[ref]):
+                    report.violations.append(Violation(
+                        "prefix_agreement",
+                        f"nodes {i} and {ref} disagree on consensus "
+                        "event order",
+                    ))
+
+    def _check_liveness(self, scenario, result, report) -> None:
+        if result.heal_tick is None:
+            # no partition/crash schedule: liveness = consensus happened
+            for i in result.honest:
+                if i in result.alive and result.consensus_counts_final[i] <= 0:
+                    report.violations.append(Violation(
+                        "liveness", f"node {i} never reached consensus",
+                    ))
+            return
+        for i in result.honest:
+            if i not in result.alive:
+                continue
+            at_heal = result.consensus_counts_at_heal.get(i, 0)
+            at_bound = result.consensus_counts_at_bound.get(
+                i, result.consensus_counts_final[i]
+            )
+            if at_bound <= at_heal:
+                report.violations.append(Violation(
+                    "liveness",
+                    f"node {i} made no consensus progress within "
+                    f"{scenario.liveness_bound} ticks of the heal at "
+                    f"tick {result.heal_tick} "
+                    f"({at_heal} -> {at_bound} events)",
+                ))
+
+    def _check_all_committed(self, scenario, result, report) -> None:
+        submitted = set(result.submitted)
+        for i in result.honest:
+            if i in result.restarted or i not in result.alive:
+                continue
+            missing = submitted - set(result.committed[i])
+            if missing:
+                sample = sorted(missing)[:3]
+                report.violations.append(Violation(
+                    "all_committed",
+                    f"node {i} never committed {len(missing)} submitted "
+                    f"tx(s), e.g. {sample}",
+                ))
+
+    def _check_fork_detected(self, scenario, result, report) -> None:
+        if scenario.plan.byzantine is None:
+            report.violations.append(Violation(
+                "fork_detected",
+                "scenario declares the fork_detected invariant but no "
+                "byzantine actor",
+            ))
+            return
+        for i in result.honest:
+            if i not in result.alive:
+                continue
+            if not result.fork_detected.get(i, False):
+                report.violations.append(Violation(
+                    "fork_detected",
+                    f"honest node {i} never detected node "
+                    f"{scenario.plan.byzantine.node}'s equivocation "
+                    + ("(the attack's branches were rejected at insert — "
+                       "fork-aware mode is off, so the fork is invisible)"
+                       if scenario.engine != "byzantine" else ""),
+                ))
+
+    def _check_fast_forwarded(self, scenario, result, report) -> None:
+        restarted = sorted(result.restarted)
+        if not restarted:
+            report.violations.append(Violation(
+                "fast_forwarded",
+                "scenario declares the fast_forwarded invariant but "
+                "no node ever restarts",
+            ))
+            return
+        if not any(result.fast_forwards.get(i, 0) > 0 for i in restarted):
+            report.violations.append(Violation(
+                "fast_forwarded",
+                f"no restarted node ({restarted}) completed a "
+                "fast-forward — the fleet never evicted past their "
+                "windows, or the snapshot path failed",
+            ))

@@ -1,0 +1,348 @@
+"""Declarative fault plans: what the chaos plane may do to the network.
+
+A :class:`FaultPlan` is pure data — per-link fault probabilities,
+scheduled partitions with heal times, node crash/restart points, and an
+optional byzantine actor — with a stable JSON form (see README "Chaos
+testing" for the schema).  The plan never draws randomness itself: the
+:class:`~babble_tpu.chaos.injector.FaultInjector` turns a (plan, seed)
+pair into concrete fault decisions, which is what makes every scenario
+reproducible from ``--seed`` alone.
+
+Time is measured in abstract **ticks**: the deterministic scenario
+runner advances one tick per gossip step, the live runner maps ticks to
+wall time through ``Scenario.tick_seconds``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: invariants the checker knows how to enforce (invariants.py)
+KNOWN_INVARIANTS = (
+    "prefix_agreement",   # safety: honest nodes commit identical order
+    "liveness",           # commits resume within a bound after heal
+    "all_committed",      # every submitted tx reaches the honest logs
+    "fork_detected",      # every honest node flagged the equivocation
+    "fast_forwarded",     # a restarted node caught up via snapshot
+)
+
+BYZANTINE_MODES = ("fork", "stale_replay")
+
+
+def _prob(v, name: str) -> float:
+    f = float(v)
+    if not 0.0 <= f <= 1.0:
+        raise ValueError(f"{name} must be a probability in [0, 1], got {v}")
+    return f
+
+
+def _ms_range(v, name: str) -> Tuple[float, float]:
+    lo, hi = (float(v[0]), float(v[1]))
+    if lo < 0 or hi < lo:
+        raise ValueError(f"{name} must be 0 <= lo <= hi ms, got {v}")
+    return (lo, hi)
+
+
+@dataclass(frozen=True)
+class LinkFaults:
+    """Per-directed-link fault probabilities.  ``delay``/``reorder`` are
+    probabilities; the matching ``*_ms`` ranges bound the injected
+    latency (reordering is modeled as extra delay on the affected
+    message relative to the messages behind it)."""
+
+    drop: float = 0.0
+    delay: float = 0.0
+    delay_ms: Tuple[float, float] = (1.0, 5.0)
+    duplicate: float = 0.0
+    reorder: float = 0.0
+    reorder_ms: Tuple[float, float] = (1.0, 10.0)
+
+    def __post_init__(self):
+        _prob(self.drop, "drop")
+        _prob(self.delay, "delay")
+        _prob(self.duplicate, "duplicate")
+        _prob(self.reorder, "reorder")
+        object.__setattr__(self, "delay_ms",
+                           _ms_range(self.delay_ms, "delay_ms"))
+        object.__setattr__(self, "reorder_ms",
+                           _ms_range(self.reorder_ms, "reorder_ms"))
+
+    def to_dict(self) -> dict:
+        return {
+            "drop": self.drop, "delay": self.delay,
+            "delay_ms": list(self.delay_ms),
+            "duplicate": self.duplicate, "reorder": self.reorder,
+            "reorder_ms": list(self.reorder_ms),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LinkFaults":
+        known = {"drop", "delay", "delay_ms", "duplicate", "reorder",
+                 "reorder_ms"}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"unknown link fault keys: {sorted(extra)}")
+        kw = dict(d)
+        for k in ("delay_ms", "reorder_ms"):
+            if k in kw:
+                kw[k] = tuple(kw[k])
+        return cls(**kw)
+
+
+@dataclass(frozen=True)
+class LinkOverride:
+    """Override the default link faults for links matching (src, dst);
+    ``None`` matches any node — ``src=2, dst=None`` degrades every link
+    *out of* node 2 (the slow-peer shape)."""
+
+    faults: LinkFaults
+    src: Optional[int] = None
+    dst: Optional[int] = None
+
+    def matches(self, src: int, dst: int) -> bool:
+        return ((self.src is None or self.src == src)
+                and (self.dst is None or self.dst == dst))
+
+
+@dataclass(frozen=True)
+class Partition:
+    """From tick ``start`` until ``heal`` (exclusive; ``None`` = never),
+    the listed group cannot exchange messages with everyone else in
+    either direction."""
+
+    group: Tuple[int, ...]
+    start: int
+    heal: Optional[int] = None
+
+    def __post_init__(self):
+        if self.heal is not None and self.heal <= self.start:
+            raise ValueError(
+                f"partition heal {self.heal} must be after start {self.start}"
+            )
+        object.__setattr__(self, "group", tuple(self.group))
+
+    def active(self, tick: float) -> bool:
+        return tick >= self.start and (self.heal is None or tick < self.heal)
+
+    def separates(self, src: int, dst: int, tick: float) -> bool:
+        if not self.active(tick):
+            return False
+        return (src in self.group) != (dst in self.group)
+
+
+@dataclass(frozen=True)
+class Crash:
+    """Node ``node`` goes down at tick ``crash``; ``restart=None``
+    means it stays down."""
+
+    node: int
+    crash: int
+    restart: Optional[int] = None
+
+    def __post_init__(self):
+        if self.restart is not None and self.restart <= self.crash:
+            raise ValueError(
+                f"restart {self.restart} must be after crash {self.crash}"
+            )
+
+
+@dataclass(frozen=True)
+class ByzantineSpec:
+    """One byzantine actor.  ``fork`` mints an equivocating event at
+    tick ``at`` and plants the branches at two different peers;
+    ``stale_replay`` answers inbound syncs with a cached stale response
+    with probability ``prob`` from tick ``at`` on."""
+
+    node: int
+    mode: str = "fork"
+    at: int = 0
+    prob: float = 0.3
+
+    def __post_init__(self):
+        if self.mode not in BYZANTINE_MODES:
+            raise ValueError(
+                f"byzantine mode {self.mode!r} not in {BYZANTINE_MODES}"
+            )
+        _prob(self.prob, "byzantine prob")
+
+
+@dataclass
+class FaultPlan:
+    """The full declarative fault surface for one scenario."""
+
+    default: LinkFaults = field(default_factory=LinkFaults)
+    overrides: List[LinkOverride] = field(default_factory=list)
+    partitions: List[Partition] = field(default_factory=list)
+    crashes: List[Crash] = field(default_factory=list)
+    byzantine: Optional[ByzantineSpec] = None
+
+    def link(self, src: int, dst: int) -> LinkFaults:
+        """Resolved faults for the directed link src -> dst (last
+        matching override wins; most-specific plans list specific
+        overrides last)."""
+        out = self.default
+        for ov in self.overrides:
+            if ov.matches(src, dst):
+                out = ov.faults
+        return out
+
+    def partitioned(self, src: int, dst: int, tick: float) -> bool:
+        return any(p.separates(src, dst, tick) for p in self.partitions)
+
+    def validate(self, n_nodes: int) -> None:
+        def _node(i, what):
+            if not 0 <= i < n_nodes:
+                raise ValueError(f"{what} node {i} out of range 0..{n_nodes - 1}")
+
+        for ov in self.overrides:
+            for v, what in ((ov.src, "override src"), (ov.dst, "override dst")):
+                if v is not None:
+                    _node(v, what)
+        for p in self.partitions:
+            for i in p.group:
+                _node(i, "partition")
+            if len(p.group) >= n_nodes:
+                raise ValueError("partition group must leave someone outside")
+        for c in self.crashes:
+            _node(c.node, "crash")
+        if self.byzantine is not None:
+            _node(self.byzantine.node, "byzantine")
+
+    def to_dict(self) -> dict:
+        out: dict = {"default": self.default.to_dict()}
+        if self.overrides:
+            out["overrides"] = [
+                {"src": ov.src, "dst": ov.dst, **ov.faults.to_dict()}
+                for ov in self.overrides
+            ]
+        if self.partitions:
+            out["partitions"] = [
+                {"group": list(p.group), "start": p.start, "heal": p.heal}
+                for p in self.partitions
+            ]
+        if self.crashes:
+            out["crashes"] = [
+                {"node": c.node, "crash": c.crash, "restart": c.restart}
+                for c in self.crashes
+            ]
+        if self.byzantine is not None:
+            b = self.byzantine
+            out["byzantine"] = {"node": b.node, "mode": b.mode,
+                                "at": b.at, "prob": b.prob}
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        known = {"default", "overrides", "partitions", "crashes", "byzantine"}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"unknown fault plan keys: {sorted(extra)}")
+        overrides = []
+        for ov in d.get("overrides", []):
+            ov = dict(ov)
+            src, dst = ov.pop("src", None), ov.pop("dst", None)
+            overrides.append(LinkOverride(
+                faults=LinkFaults.from_dict(ov), src=src, dst=dst,
+            ))
+        byz = d.get("byzantine")
+        return cls(
+            default=LinkFaults.from_dict(d.get("default", {})),
+            overrides=overrides,
+            partitions=[Partition(**p) for p in d.get("partitions", [])],
+            crashes=[Crash(**c) for c in d.get("crashes", [])],
+            byzantine=ByzantineSpec(**byz) if byz else None,
+        )
+
+
+@dataclass
+class Scenario:
+    """A fault plan plus the cluster + workload it runs against and the
+    invariants the result must satisfy."""
+
+    name: str
+    nodes: int = 4
+    steps: int = 240
+    seed: int = 7
+    plan: FaultPlan = field(default_factory=FaultPlan)
+    #: consensus engine the cluster runs: "fused" (honest) or
+    #: "byzantine" (fork-aware).  A fork-attack scenario run with
+    #: "fused" is the intentionally-broken demo — the attack's branches
+    #: are rejected instead of detected, and the fork_detected
+    #: invariant fails loudly.
+    engine: str = "fused"
+    cache_size: int = 512
+    seq_window: Optional[int] = None
+    txs: int = 16
+    tx_every: int = 5
+    invariants: Tuple[str, ...] = ("prefix_agreement", "liveness")
+    #: liveness bound: consensus must advance on every honest live node
+    #: within this many ticks of the last heal/restart
+    liveness_bound: int = 120
+    #: fault-free all-to-all gossip rounds appended after the plan runs
+    #: (the "network eventually behaves" phase convergence checks need)
+    settle_rounds: int = 6
+    #: live mode: wall seconds per tick
+    tick_seconds: float = 0.05
+
+    def __post_init__(self):
+        if self.nodes < 2:
+            raise ValueError("a scenario needs at least 2 nodes")
+        if self.steps <= 0:
+            raise ValueError("steps must be positive")
+        if self.engine not in ("fused", "byzantine"):
+            raise ValueError(f"unknown engine {self.engine!r}")
+        unknown = set(self.invariants) - set(KNOWN_INVARIANTS)
+        if unknown:
+            raise ValueError(
+                f"unknown invariants {sorted(unknown)}; "
+                f"known: {KNOWN_INVARIANTS}"
+            )
+        object.__setattr__(self, "invariants", tuple(self.invariants))
+        self.plan.validate(self.nodes)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "nodes": self.nodes, "steps": self.steps,
+            "seed": self.seed, "engine": self.engine,
+            "cache_size": self.cache_size, "seq_window": self.seq_window,
+            "txs": self.txs, "tx_every": self.tx_every,
+            "invariants": list(self.invariants),
+            "liveness_bound": self.liveness_bound,
+            "settle_rounds": self.settle_rounds,
+            "tick_seconds": self.tick_seconds,
+            "plan": self.plan.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Scenario":
+        d = dict(d)
+        plan = FaultPlan.from_dict(d.pop("plan", {}))
+        known = {
+            "name", "nodes", "steps", "seed", "engine", "cache_size",
+            "seq_window", "txs", "tx_every", "invariants",
+            "liveness_bound", "settle_rounds", "tick_seconds",
+        }
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"unknown scenario keys: {sorted(extra)}")
+        if "invariants" in d:
+            d["invariants"] = tuple(d["invariants"])
+        return cls(plan=plan, **d)
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "Scenario":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+
+#: per-tick schedule of crash/restart actions, derived once per run
+def crash_schedule(plan: FaultPlan) -> Dict[int, List[Tuple[str, int]]]:
+    """tick -> [("crash"|"restart", node)] in declaration order."""
+    out: Dict[int, List[Tuple[str, int]]] = {}
+    for c in plan.crashes:
+        out.setdefault(c.crash, []).append(("crash", c.node))
+        if c.restart is not None:
+            out.setdefault(c.restart, []).append(("restart", c.node))
+    return out

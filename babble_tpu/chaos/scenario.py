@@ -1,0 +1,571 @@
+"""Scenario runner: execute a FaultPlan against a real node cluster.
+
+Two execution modes over the same plan:
+
+**Deterministic in-memory cluster** (:class:`ScenarioRunner`) — full
+Node objects (gossip protocol, core lock, commit queue, fast-forward
+path) over ``InmemNetwork`` transports wrapped in ``FaultyTransport``,
+driven *sequentially*: the runner owns the only source of initiative
+(one gossip exchange per step, consensus on an explicit cadence), node
+select-loops run with heartbeats off and exist purely to serve inbound
+RPCs and drain commits.  Combined with seed-derived identities
+(:func:`~babble_tpu.crypto.keys.key_from_scalar`), deterministic ECDSA
+nonces, a seeded logical event clock and the injector's per-link RNG
+streams, two runs of the same (scenario, seed) produce bit-identical
+fault schedules AND bit-identical committed orders — the property the
+acceptance tests fingerprint.
+
+**Live fleet** (:func:`run_live`) — a ``testnet.TestnetRunner``
+subprocess fleet where every node self-injects faults from the same
+(plan, seed) via ``babble-tpu run --chaos_plan`` (cli.py wraps the TCP
+transport in a FaultyTransport), the runner drives crash/restart from
+the plan's schedule against wall-clock ticks, and the report is a
+fleet-wide /Stats + /metrics sweep (``babble_chaos_faults_total``
+distinguishes injected faults from organic ones).  Wall-clock fleets
+are not bit-reproducible — the *fault schedule* still is, per link.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..crypto.keys import P256_ORDER, KeyPair, key_from_scalar, sha256
+from ..net.inmem_transport import InmemNetwork
+from ..net.peers import Peer
+from ..node.config import Config
+from ..node.node import Node
+from ..proxy.inmem import InmemAppProxy
+from .injector import FaultInjector
+from .invariants import InvariantChecker, InvariantReport
+from .plan import ByzantineSpec, Scenario, crash_schedule
+from .transport import FaultyTransport
+
+
+def deterministic_keys(seed: int, n: int) -> List[KeyPair]:
+    """n keypairs derived from the seed, sorted by pub hex so list
+    index == canonical participant id."""
+    keys = []
+    for i in range(n):
+        digest = sha256(f"babble-chaos-key:{seed}:{i}".encode())
+        d = int.from_bytes(digest, "big") % (P256_ORDER - 1) + 1
+        keys.append(key_from_scalar(d))
+    return sorted(keys, key=lambda k: k.pub_hex)
+
+
+@dataclass
+class ScenarioResult:
+    """Everything a scenario run observed, in JSON-able form."""
+
+    name: str
+    seed: int
+    steps: int
+    fault_schedule: List[tuple] = field(default_factory=list)
+    fault_counts: Dict[str, int] = field(default_factory=dict)
+    committed: Dict[int, List[str]] = field(default_factory=dict)
+    consensus: Dict[int, List[str]] = field(default_factory=dict)
+    submitted: List[str] = field(default_factory=list)
+    honest: List[int] = field(default_factory=list)
+    restarted: Set[int] = field(default_factory=set)
+    alive: Set[int] = field(default_factory=set)
+    heal_tick: Optional[int] = None
+    consensus_counts_at_heal: Dict[int, int] = field(default_factory=dict)
+    consensus_counts_at_bound: Dict[int, int] = field(default_factory=dict)
+    consensus_counts_final: Dict[int, int] = field(default_factory=dict)
+    fork_detected: Dict[int, bool] = field(default_factory=dict)
+    fast_forwards: Dict[int, int] = field(default_factory=dict)
+    fork_attack: Optional[dict] = None
+    report: Optional[InvariantReport] = None
+
+    def fingerprint(self) -> str:
+        """SHA-256 over the canonical fault schedule + every node's
+        committed/consensus order — identical across runs iff the run
+        was bit-for-bit reproduced."""
+        payload = json.dumps({
+            "schedule": [list(t) for t in self.fault_schedule],
+            "committed": {str(k): v for k, v in sorted(self.committed.items())},
+            "consensus": {str(k): v for k, v in sorted(self.consensus.items())},
+        }, sort_keys=True).encode()
+        return hashlib.sha256(payload).hexdigest()
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "seed": self.seed, "steps": self.steps,
+            "fingerprint": self.fingerprint(),
+            "fault_counts": dict(self.fault_counts),
+            "fault_schedule": [list(t) for t in self.fault_schedule],
+            "committed": {str(k): v for k, v in sorted(self.committed.items())},
+            "submitted": list(self.submitted),
+            "honest": list(self.honest),
+            "restarted": sorted(self.restarted),
+            "alive": sorted(self.alive),
+            "heal_tick": self.heal_tick,
+            "consensus_counts": {
+                str(k): v
+                for k, v in sorted(self.consensus_counts_final.items())
+            },
+            "fork_detected": {
+                str(k): v for k, v in sorted(self.fork_detected.items())
+            },
+            "fast_forwards": {
+                str(k): v for k, v in sorted(self.fast_forwards.items())
+            },
+            "fork_attack": self.fork_attack,
+            "invariants": self.report.to_dict() if self.report else None,
+        }
+
+
+@dataclass
+class _Handle:
+    idx: int
+    addr: str
+    key: KeyPair
+    node: Optional[Node] = None
+    proxy: Optional[InmemAppProxy] = None
+    alive: bool = True
+    saved_engine: object = None
+    engine_at_restart: object = None
+    restarted: bool = False
+
+
+class ScenarioRunner:
+    """Deterministic in-memory execution of one scenario."""
+
+    def __init__(self, scenario: Scenario, seed: Optional[int] = None,
+                 consensus_every: int = 6):
+        self.scenario = scenario
+        self.seed = scenario.seed if seed is None else seed
+        self.consensus_every = consensus_every
+
+    def run(self) -> ScenarioResult:
+        return asyncio.run(self._run())
+
+    # ------------------------------------------------------------------
+
+    async def _run(self) -> ScenarioResult:
+        sc = self.scenario
+        n = sc.nodes
+        seed = self.seed
+        injector = FaultInjector(sc.plan, seed)
+        rng = random.Random(f"babble-chaos-scenario:{seed}")
+        # logical event clock: strictly increasing ns, identical across
+        # runs because every event creation happens inside one of the
+        # runner's sequential awaits
+        tick_ns = {"t": 1_700_000_000_000_000_000}
+
+        def clock() -> int:
+            tick_ns["t"] += 1_000_000
+            return tick_ns["t"]
+
+        keys = deterministic_keys(seed, n)
+        addrs = [f"inmem://chaos{i}" for i in range(n)]
+        addr_index = {a: i for i, a in enumerate(addrs)}
+        peers = [
+            Peer(net_addr=addrs[i], pub_key_hex=keys[i].pub_hex)
+            for i in range(n)
+        ]
+        net = InmemNetwork()
+        handles = [
+            _Handle(idx=i, addr=addrs[i], key=keys[i]) for i in range(n)
+        ]
+
+        def make_conf() -> Config:
+            conf = Config.test_config(heartbeat=1.0)
+            conf.cache_size = sc.cache_size
+            conf.seq_window = sc.seq_window
+            conf.byzantine = (sc.engine == "byzantine")
+            # positive interval with gossip=False means: syncs only mark
+            # the pipeline dirty and the RUNNER decides when consensus
+            # runs (a timer task would reintroduce wall-clock
+            # nondeterminism) — see _maybe_consensus
+            conf.consensus_interval = 1e9
+            return conf
+
+        def boot(h: _Handle, engine=None) -> None:
+            inner = net.transport(h.addr)
+            transport = FaultyTransport(inner, injector, h.idx, addr_index)
+            h.proxy = InmemAppProxy()
+            h.node = Node(make_conf(), h.key, peers, transport, h.proxy,
+                          engine=engine)
+            h.node.core.now_ns = clock
+            if engine is None:
+                h.node.init()
+            h.node.run_task(gossip=False)
+            h.alive = True
+
+        for h in handles:
+            boot(h)
+
+        byz = sc.plan.byzantine
+        honest = [i for i in range(n) if byz is None or byz.node != i]
+        result = ScenarioResult(name=sc.name, seed=seed, steps=sc.steps,
+                                honest=honest)
+        sched = crash_schedule(sc.plan)
+        heal_ticks = [p.heal for p in sc.plan.partitions
+                      if p.heal is not None]
+        heal_ticks += [c.restart for c in sc.plan.crashes
+                       if c.restart is not None]
+        heal_tick = max(heal_ticks) if heal_ticks else None
+        result.heal_tick = heal_tick
+        submitted = 0
+        fork_done = False
+
+        async def gossip_once(a: int, b: int) -> None:
+            await handles[a].node._gossip(addrs[b])
+
+        async def sample_counts() -> Dict[int, int]:
+            out = {}
+            for h in handles:
+                if h.alive:
+                    out[h.idx] = h.node.core.hg.consensus_events_count()
+            return out
+
+        try:
+            for step in range(sc.steps):
+                injector.advance_to(step)
+                for action, node_idx in sched.get(step, ()):
+                    h = handles[node_idx]
+                    if action == "crash" and h.alive:
+                        h.saved_engine = h.node.core.hg
+                        await h.node.shutdown()
+                        h.alive = False
+                        injector.record("crash", node_idx, node_idx)
+                    elif action == "restart" and not h.alive:
+                        # restart from the engine the node held at crash
+                        # time — the checkpoint-restored-process model.
+                        # If the fleet moved past its window meanwhile,
+                        # its first syncs draw too_late -> fast-forward.
+                        boot(h, engine=h.saved_engine)
+                        h.engine_at_restart = h.node.core.hg
+                        h.restarted = True
+                        result.restarted.add(node_idx)
+                        injector.record("restart", node_idx, node_idx)
+                if heal_tick is not None and step == heal_tick:
+                    result.consensus_counts_at_heal = await sample_counts()
+                if (heal_tick is not None
+                        and step == heal_tick + sc.liveness_bound):
+                    result.consensus_counts_at_bound = await sample_counts()
+
+                if (submitted < sc.txs and sc.tx_every > 0
+                        and step % sc.tx_every == 0):
+                    live = [h for h in handles if h.alive]
+                    target = rng.choice(live)
+                    payload = (
+                        f"chaos-tx-{submitted}-"
+                        f"{rng.getrandbits(32):08x}".encode()
+                    )
+                    async with target.node.core_lock:
+                        target.node.transaction_pool.append(payload)
+                    result.submitted.append(payload.hex())
+                    submitted += 1
+
+                if (byz is not None and byz.mode == "fork"
+                        and not fork_done and step >= byz.at):
+                    attack = await self._inject_fork(
+                        handles, byz, rng, clock, injector
+                    )
+                    if attack.get("deferred"):
+                        # the branch's self-parent hasn't reached two
+                        # honest peers yet — a fork nobody can insert
+                        # proves nothing; retry next step
+                        pass
+                    else:
+                        result.fork_attack = attack
+                        fork_done = True
+
+                live_idx = [h.idx for h in handles if h.alive]
+                if len(live_idx) >= 2:
+                    a = rng.choice(live_idx)
+                    # deliberate: the target draw includes crashed nodes
+                    # — a real peer selector dials from peers.json with
+                    # no liveness oracle, so the fleet keeps paying the
+                    # dial-a-dead-peer failure exactly like production
+                    b = rng.choice([i for i in range(n) if i != a])
+                    await gossip_once(a, b)
+
+                if step % self.consensus_every == self.consensus_every - 1:
+                    await self._consensus_pass(handles)
+
+            # settle: the network behaves, everyone reconciles — the
+            # phase that makes convergence invariants meaningful
+            injector.advance_to(sc.steps)
+            injector.quiesce = True
+            for _ in range(sc.settle_rounds):
+                for a in range(n):
+                    if not handles[a].alive:
+                        continue
+                    for b in range(n):
+                        if b != a and handles[b].alive:
+                            await gossip_once(a, b)
+                await self._consensus_pass(handles)
+            await self._consensus_pass(handles, force=True)
+            await self._drain_commits(handles)
+
+            result.consensus_counts_final = await sample_counts()
+            if heal_tick is not None and not result.consensus_counts_at_bound:
+                result.consensus_counts_at_bound = dict(
+                    result.consensus_counts_final
+                )
+            for h in handles:
+                if not h.alive:
+                    continue
+                result.alive.add(h.idx)
+                result.committed[h.idx] = [
+                    tx.hex() for tx in h.proxy.committed_transactions()
+                ]
+                result.consensus[h.idx] = list(
+                    h.node.core.hg.consensus_events()
+                )
+                snap = h.node.core.hg.stats_snapshot()
+                result.fork_detected[h.idx] = (
+                    snap.get("forked_creators", 0) > 0
+                )
+                # a completed fast-forward swapped the engine object the
+                # node restarted with — attempt counters alone can't
+                # distinguish a failed catch-up from a successful one
+                swapped = (h.restarted
+                           and h.node.core.hg is not h.engine_at_restart)
+                result.fast_forwards[h.idx] = 1 if swapped else 0
+        finally:
+            for h in handles:
+                if h.alive:
+                    await h.node.shutdown()
+
+        result.fault_schedule = injector.schedule_fingerprint()
+        counts: Dict[str, int] = {}
+        for entry in injector.log:
+            counts[entry["kind"]] = counts.get(entry["kind"], 0) + 1
+        result.fault_counts = counts
+        result.report = InvariantChecker().check(self.scenario, result)
+        return result
+
+    # ------------------------------------------------------------------
+
+    async def _consensus_pass(self, handles, force: bool = False) -> None:
+        """Run the consensus pipeline on every dirty live node, in node
+        order (the runner-owned cadence that replaces the wall-clock
+        _consensus_loop timer)."""
+        for h in handles:
+            if not h.alive:
+                continue
+            if not (force or h.node._consensus_dirty):
+                continue
+            h.node._consensus_dirty = False
+            async with h.node.core_lock:
+                await h.node._run_consensus_locked(0)
+
+    async def _drain_commits(self, handles) -> None:
+        """Wait until every committer has fully DELIVERED its queue —
+        an empty queue still races the batch the committer already
+        popped, and a wall-clock sleep there would make the sampled
+        committed logs (and the reproducibility fingerprint) timing-
+        dependent.  Queue.join() fires on the committer's task_done,
+        after the last app ack."""
+        for h in handles:
+            if not h.alive:
+                continue
+            try:
+                await asyncio.wait_for(h.node._commit_queue.join(), 60.0)
+            except asyncio.TimeoutError:
+                # a wedged committer (app refusing every retry) must not
+                # hang the whole run — the invariant checker will say
+                # what's missing
+                pass
+
+    async def _inject_fork(self, handles, byz: ByzantineSpec, rng, clock,
+                           injector) -> dict:
+        """The fork-emitting peer: mint an equivocating event (same
+        creator, same index, different content) off the byzantine
+        node's earliest live event and plant each branch at a different
+        honest peer.  Fork-aware engines accept and later *detect* it;
+        honest engines reject the branch at insert — which is exactly
+        why the fork-attack-with-detection-disabled variant fails its
+        fork_detected invariant."""
+        from ..core.event import new_event
+
+        h = handles[byz.node]
+        if not h.alive:
+            return {"injected": False, "reason": "byzantine node down"}
+        core = h.node.core
+        cid = byz.node
+        async with h.node.core_lock:
+            if core.byzantine:
+                slots = core.hg.dag.cr_events[cid]
+                base = core.hg.dag.events[slots[0]] if slots else None
+            else:
+                chain = core.hg.dag.chains[cid]
+                base = (core.hg.dag.events[chain[chain.start]]
+                        if len(chain) else None)
+        if base is None:
+            return {"injected": False, "reason": "no base event"}
+
+        def _knows_fork_site(target_core) -> bool:
+            # the target must hold the base AND a genuine event at the
+            # forged index: without the genuine sibling, the branch is
+            # just the next chain event (no equivocation to detect, and
+            # honest engines would accept it as real)
+            dag = target_core.hg.dag
+            if base.hex() not in dag.slot_of:
+                return False
+            if target_core.byzantine:
+                slots = dag.cr_events[cid]
+            else:
+                slots = list(dag.chains[cid])
+            return any(
+                dag.events[s].index == base.index + 1 for s in slots
+            )
+
+        ready = []
+        for x in handles:
+            if x.idx == byz.node or not x.alive:
+                continue
+            async with x.node.core_lock:
+                if _knows_fork_site(x.node.core):
+                    ready.append(x)
+        if len(ready) < 2:
+            return {"injected": False, "deferred": True}
+        targets = rng.sample(ready, 2)
+        accepted, rejected = [], []
+        for t, tag in zip(targets, (b"a", b"b")):
+            async with t.node.core_lock:
+                other = t.node.core.head
+            ev = new_event(
+                [b"chaos-fork-" + tag], (base.hex(), other),
+                h.key.pub_bytes, base.index + 1, timestamp=clock(),
+            )
+            ev.sign(h.key)
+            try:
+                async with t.node.core_lock:
+                    t.node.core.insert_event(ev)
+                accepted.append(t.idx)
+            except ValueError as e:
+                rejected.append({"node": t.idx, "error": str(e)})
+        injector.record("fork_attack", byz.node, -1,
+                        accepted=len(accepted))
+        return {"injected": True, "accepted": accepted,
+                "rejected": rejected}
+
+
+def run_scenario(scenario: Scenario,
+                 seed: Optional[int] = None) -> ScenarioResult:
+    """One deterministic in-memory run; result carries the invariant
+    report (``result.report.ok``)."""
+    return ScenarioRunner(scenario, seed=seed).run()
+
+
+# ----------------------------------------------------------------------
+# live fleets
+
+
+def run_live(
+    scenario: Scenario,
+    base_dir: str,
+    rate: float = 25.0,
+    log=print,
+) -> dict:
+    """Execute a scenario against a live subprocess fleet.  Every node
+    self-injects link faults from the shared (plan, seed) via
+    ``--chaos_plan`` (see cli.py); this driver owns only the
+    crash/restart schedule and the workload.  Returns a fleet report
+    (stats sweep + per-node injected-fault counters); invariant depth
+    belongs to the deterministic runner."""
+    import os
+    import threading
+    import time
+
+    from .. import testnet as tn
+
+    os.makedirs(base_dir, exist_ok=True)
+    plan_path = os.path.join(base_dir, "scenario.json")
+    with open(plan_path, "w") as f:
+        json.dump(scenario.to_dict(), f, indent=1)
+
+    # one shared tick-0 for the whole fleet, restarts included — each
+    # node's injector maps wall time to plan ticks from this epoch, so
+    # a relaunched node rejoins the schedule in phase
+    epoch = time.time()
+    runner = tn.TestnetRunner(
+        base_dir, scenario.nodes, heartbeat_ms=20,
+        # generous sync timeout: injected delays ride on top of real
+        # RTTs, and byzantine-mode consensus per sync is heavy on
+        # oversubscribed hosts — 200 ms would read every slow response
+        # as a failure and drown the chaos signal in organic timeouts
+        tcp_timeout_ms=1500,
+        extra_node_args=[
+            "--chaos_plan", plan_path, "--chaos_seed", str(scenario.seed),
+            "--chaos_epoch", repr(epoch),
+        ],
+        # crash/restart in a live fleet needs both: recent checkpoints
+        # (or the restart boots a fresh root) and fork-aware engines (a
+        # restart from a stale checkpoint re-mints already-published
+        # sequence numbers, which only byzantine mode tolerates — see
+        # the ROADMAP crash-recovery-amnesia item)
+        byzantine=(scenario.engine == "byzantine"
+                   or bool(scenario.plan.crashes)),
+        checkpoints=bool(scenario.plan.crashes),
+    )
+    duration = scenario.steps * scenario.tick_seconds
+    sched = crash_schedule(scenario.plan)
+    report: dict = {"name": scenario.name, "seed": scenario.seed,
+                    "duration_s": duration}
+    runner.start()
+    try:
+        bomber = threading.Thread(
+            target=lambda: asyncio.run(tn.bombard(
+                scenario.nodes, rate, duration, runner.ports,
+                seed=scenario.seed,
+            )),
+            daemon=True,
+        )
+        bomber.start()
+        # the driver walks the SAME epoch the nodes' injectors use, so
+        # crash/restart actions stay in phase with the plan's partition
+        # windows; ticks that elapsed during fleet boot are processed
+        # immediately (their sleep clamps to zero)
+        for tick in range(scenario.steps):
+            for action, node_idx in sched.get(tick, ()):
+                if action == "crash":
+                    log(f"[chaos] tick {tick}: crash node {node_idx}")
+                    runner.kill_node(node_idx)
+                else:
+                    log(f"[chaos] tick {tick}: restart node {node_idx}")
+                    runner.restart_node(node_idx)
+            deadline = epoch + (tick + 1) * scenario.tick_seconds
+            time.sleep(max(0.0, deadline - time.time()))
+        bomber.join(timeout=30)
+        report["stats"] = tn.watch_once(scenario.nodes, runner.ports)
+        faults: Dict[str, Dict[str, float]] = {}
+        for i in range(scenario.nodes):
+            addr = runner.ports.of(i)["service"]
+            try:
+                text = tn.fetch_metrics(addr)
+            except Exception as e:   # a crashed-for-good node has none
+                faults[str(i)] = {"error": str(e)}
+                continue
+            per = {}
+            for line in text.splitlines():
+                if line.startswith("babble_chaos_faults_total{"):
+                    kind = line.split('kind="', 1)[1].split('"', 1)[0]
+                    per[kind] = float(line.rsplit(" ", 1)[1])
+            faults[str(i)] = per
+        report["chaos_faults"] = faults
+
+        def _events(row) -> int:
+            try:
+                return int(row.get("consensus_events", "0"))
+            except (TypeError, ValueError):
+                return 0
+
+        # every REACHABLE node must have advanced, and at least one node
+        # must actually be reachable — without the any(), a fleet that
+        # never booted (all rows are error rows) would vacuously pass
+        report["advanced"] = all(
+            "error" in row or _events(row) > 0 for row in report["stats"]
+        ) and any(_events(row) > 0 for row in report["stats"])
+    finally:
+        runner.stop()
+    return report

@@ -1,0 +1,121 @@
+"""Canned chaos scenarios — the regression suite for hostile networks.
+
+Each is a plain dict (the JSON schema the ``babble-tpu chaos`` CLI
+accepts from a file, README "Chaos testing"), so ``chaos show <name>``
+doubles as schema-by-example.  Step counts are sized for the
+deterministic in-memory runner on a CPU-only host; a seed sweep over
+all of them is the ``slow``-marked chaos pytest tier.
+
+The intentionally-broken demo is not canned: take ``fork-attack`` and
+flip ``engine`` to ``"fused"`` (fork detection off) — the attack's
+branches are rejected instead of detected and the ``fork_detected``
+invariant fails loudly (tests/test_chaos_scenarios.py pins this).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .plan import Scenario
+
+CANNED: Dict[str, dict] = {
+    # every link is lossy, latent, duplicating and reordering at once —
+    # the baseline "hostile but connected" network
+    "flaky-link": {
+        "name": "flaky-link",
+        "nodes": 4, "steps": 240, "seed": 7,
+        "txs": 20, "tx_every": 8,
+        "invariants": ["prefix_agreement", "liveness", "all_committed"],
+        "plan": {
+            "default": {
+                "drop": 0.12, "delay": 0.2, "delay_ms": [1, 4],
+                "duplicate": 0.08, "reorder": 0.08, "reorder_ms": [1, 6],
+            },
+        },
+    },
+    # one node is cut from the supermajority for a third of the run;
+    # the majority must keep committing, the minority must rejoin
+    "minority-partition": {
+        "name": "minority-partition",
+        "nodes": 4, "steps": 320, "seed": 11,
+        "txs": 16, "tx_every": 10, "liveness_bound": 120,
+        "invariants": ["prefix_agreement", "liveness"],
+        "plan": {
+            "default": {"drop": 0.05},
+            "partitions": [{"group": [3], "start": 60, "heal": 180}],
+        },
+    },
+    # a node dies at tick 0 (before its root propagates), the fleet's
+    # rolling windows evict far past it, and the restart can only catch
+    # up through the snapshot RPC.  The crash must predate propagation
+    # because slot-prefix eviction retains every known creator's last
+    # seq_window events — once a creator's events are in the window,
+    # its silence WEDGES eviction at its oldest retained slot and the
+    # window stops moving entirely (chaos surfaced this; recorded as a
+    # ROADMAP open item), so mid-life downtime can never trigger a
+    # fast-forward in the current engine
+    "crash-restart-with-fast-forward": {
+        "name": "crash-restart-with-fast-forward",
+        "nodes": 4, "steps": 480, "seed": 13,
+        "cache_size": 64, "seq_window": 8,
+        "txs": 12, "tx_every": 12, "liveness_bound": 100,
+        "invariants": ["prefix_agreement", "liveness", "fast_forwarded"],
+        "plan": {
+            "crashes": [{"node": 3, "crash": 0, "restart": 340}],
+        },
+    },
+    # a fork-emitting peer plants equivocating branches at two honest
+    # nodes; the fork-aware engine must detect it AND keep agreeing
+    "fork-attack": {
+        "name": "fork-attack",
+        "nodes": 4, "steps": 160, "seed": 17,
+        "engine": "byzantine",
+        "txs": 12, "tx_every": 8,
+        "invariants": ["prefix_agreement", "fork_detected", "liveness"],
+        "plan": {
+            "default": {"drop": 0.05},
+            "byzantine": {"node": 3, "mode": "fork", "at": 30},
+        },
+    },
+    # every link touching one node is slow in both directions — the
+    # laggard must neither stall the fleet nor fall out of agreement
+    "slow-peer": {
+        "name": "slow-peer",
+        "nodes": 4, "steps": 240, "seed": 19,
+        "txs": 16, "tx_every": 10,
+        "invariants": ["prefix_agreement", "liveness", "all_committed"],
+        "plan": {
+            "default": {"drop": 0.03},
+            "overrides": [
+                {"src": 2, "delay": 1.0, "delay_ms": [2, 6],
+                 "drop": 0.03},
+                {"dst": 2, "delay": 1.0, "delay_ms": [2, 6],
+                 "drop": 0.03},
+            ],
+        },
+    },
+    # a stale-sync replayer answers a sampled fraction of inbound syncs
+    # with cached old state; dedup-by-hash must shrug it off
+    "stale-replay": {
+        "name": "stale-replay",
+        "nodes": 4, "steps": 240, "seed": 23,
+        "txs": 16, "tx_every": 10,
+        "invariants": ["prefix_agreement", "liveness", "all_committed"],
+        "plan": {
+            "default": {"drop": 0.05},
+            "byzantine": {"node": 1, "mode": "stale_replay",
+                          "at": 20, "prob": 0.4},
+        },
+    },
+}
+
+
+def canned_names() -> list:
+    return sorted(CANNED)
+
+
+def load_scenario(name_or_path: str) -> Scenario:
+    """A canned scenario by name, or any scenario JSON file by path."""
+    if name_or_path in CANNED:
+        return Scenario.from_dict(CANNED[name_or_path])
+    return Scenario.from_json_file(name_or_path)

@@ -143,6 +143,14 @@ async def _run_node(args) -> int:
         args.node_addr, max_pool=args.max_pool,
         timeout=conf.tcp_timeout,
     )
+    if getattr(args, "chaos_plan", ""):
+        # self-injected faults for live fleets: every node wraps its TCP
+        # transport in the same (plan, seed)-driven FaultyTransport the
+        # in-memory scenario runner uses, deriving its own link identity
+        # from the canonical peer order — no per-node flags needed
+        transport = _chaos_wrap(transport, args, key, peers)
+        print(f"chaos plan {args.chaos_plan} active "
+              f"(seed {transport.injector.seed})", file=sys.stderr)
 
     if args.no_client:
         proxy = InmemAppProxy()
@@ -176,6 +184,46 @@ async def _run_node(args) -> int:
         await service.close()
         await node.shutdown()
     return 0
+
+
+def _chaos_wrap(transport, args, key, peers):
+    """Wrap a live node's transport in a FaultyTransport driven by the
+    scenario (or bare fault-plan) JSON at --chaos_plan.  Ticks map to
+    wall time through the scenario's tick_seconds; link identities are
+    canonical participant ids, so every node in the fleet derives the
+    same per-link fault streams from the shared seed."""
+    import time
+
+    from .chaos import FaultInjector, FaultPlan, FaultyTransport, Scenario
+    from .net.peers import canonical_ids
+
+    with open(args.chaos_plan) as f:
+        spec = json.load(f)
+    if "plan" in spec:
+        sc = Scenario.from_dict(spec)
+        plan, tick_seconds, seed = sc.plan, sc.tick_seconds, sc.seed
+    else:
+        plan, tick_seconds, seed = FaultPlan.from_dict(spec), 0.05, 0
+    if getattr(args, "chaos_seed", None) is not None:
+        seed = args.chaos_seed
+    ids = canonical_ids(peers)
+    addr_index = {p.net_addr: ids[p.pub_key_hex] for p in peers}
+    own = ids[key.pub_hex]
+    plan.validate(len(peers))
+    # tick 0 is the FLEET's epoch, not this process's boot: a node
+    # relaunched mid-run (crash/restart schedule) must rejoin the shared
+    # timeline, or it would replay the plan's partition/byzantine
+    # schedule out of phase with everyone else.  The fleet driver passes
+    # --chaos_epoch (unix seconds) to every node for exactly this;
+    # without it, boot time is the epoch (single-boot fleets).
+    epoch = getattr(args, "chaos_epoch", None)
+    if epoch is None:
+        epoch = time.time()
+    injector = FaultInjector(
+        plan, seed,
+        clock=lambda: (time.time() - epoch) / tick_seconds,
+    )
+    return FaultyTransport(transport, injector, own, addr_index)
 
 
 async def _checkpoint_loop(node, ckpt_dir: str, interval: float) -> None:
@@ -372,6 +420,25 @@ def cmd_fleet(args) -> int:
         return 0
     if args.fleet_cmd == "scrape":
         rows = fl.scrape_hosts(layout)
+        if getattr(args, "spans", False):
+            # merge the span sweep into the metrics rows; span output is
+            # structured (trees), so this mode is always JSON.  A
+            # loopback-gated host's spans row carries kind='gated' —
+            # expected policy, so it does not flip the exit code the way
+            # a missing /metrics blob does.
+            for row, srow in zip(rows, fl.scrape_spans(layout)):
+                if "spans" in srow:
+                    row["spans"] = srow["spans"]
+                else:
+                    row["spans_kind"] = srow["kind"]
+                    row["spans_error"] = srow["error"]
+            print(json.dumps(rows, indent=1))
+            ok = all(
+                "metrics" in r
+                and ("spans" in r or r.get("spans_kind") == "gated")
+                for r in rows
+            )
+            return 0 if ok else 1
         if args.json:
             print(json.dumps(rows, indent=1))
         else:
@@ -388,6 +455,61 @@ def cmd_fleet(args) -> int:
                           file=sys.stderr)
         return 0 if all("metrics" in r for r in rows) else 1
     raise SystemExit(f"unknown fleet subcommand {args.fleet_cmd}")
+
+
+def cmd_chaos(args) -> int:
+    from .chaos import (
+        CANNED,
+        Scenario,
+        canned_names,
+        load_scenario,
+        run_live,
+        run_scenario,
+    )
+
+    if args.chaos_cmd == "list":
+        for name in canned_names():
+            sc = CANNED[name]
+            print(f"{name}: {sc['nodes']} nodes, {sc['steps']} steps, "
+                  f"engine={sc.get('engine', 'fused')}, "
+                  f"invariants={','.join(sc['invariants'])}")
+        return 0
+    if args.chaos_cmd == "show":
+        print(json.dumps(load_scenario(args.scenario).to_dict(), indent=1))
+        return 0
+    if args.chaos_cmd == "run":
+        sc = load_scenario(args.scenario)
+        overrides = {}
+        if args.seed is not None:
+            overrides["seed"] = args.seed
+        if args.steps is not None:
+            overrides["steps"] = args.steps
+        if args.nodes is not None:
+            overrides["nodes"] = args.nodes
+        if overrides:
+            sc = Scenario.from_dict({**sc.to_dict(), **overrides})
+        if args.live:
+            report = run_live(sc, args.dir)
+            print(json.dumps(report, indent=1))
+            return 0 if report.get("advanced") else 1
+        result = run_scenario(sc)
+        if args.json:
+            print(json.dumps(result.to_dict(), indent=1))
+        else:
+            print(f"scenario {result.name} seed={result.seed} "
+                  f"steps={result.steps}")
+            print(f"fingerprint {result.fingerprint()}")
+            print(f"faults injected: {result.fault_counts or '{}'}")
+            print("consensus events: " + ", ".join(
+                f"node{i}={c}"
+                for i, c in sorted(result.consensus_counts_final.items())
+            ))
+            print(result.report.format())
+        if not result.report.ok:
+            print("CHAOS RUN FAILED: invariant violation(s) above",
+                  file=sys.stderr)
+        return 0 if result.report.ok else 1
+    raise SystemExit(f"unknown chaos subcommand {args.chaos_cmd}")
 
 
 def main(argv=None) -> int:
@@ -457,6 +579,15 @@ def main(argv=None) -> int:
                     help="resume from + periodically checkpoint to this dir")
     rn.add_argument("--checkpoint_interval", type=float, default=30.0,
                     help="seconds between checkpoints")
+    rn.add_argument("--chaos_plan", default="",
+                    help="scenario/fault-plan JSON: wrap the transport "
+                         "in a seeded FaultyTransport (chaos testing)")
+    rn.add_argument("--chaos_seed", type=int, default=None,
+                    help="override the chaos plan's seed")
+    rn.add_argument("--chaos_epoch", type=float, default=None,
+                    help="fleet-wide tick-0 (unix seconds) so restarted "
+                         "nodes rejoin the shared chaos timeline "
+                         "(default: this process's boot time)")
     rn.set_defaults(fn=cmd_run)
 
     sm = sub.add_parser("sim", help="batch consensus over a generated DAG")
@@ -528,10 +659,41 @@ def main(argv=None) -> int:
             sp.add_argument("--json", action="store_true",
                             help="emit the sweep as a JSON row list "
                                  "instead of concatenated text")
+            sp.add_argument("--spans", action="store_true",
+                            help="also fetch each host's /debug/spans "
+                                 "(loopback-gated hosts report kind="
+                                 "'gated'); implies JSON output")
         if name == "bombard":
             sp.add_argument("--rate", type=float, default=50.0, help="tx/s")
             sp.add_argument("--duration", type=float, default=10.0)
         sp.set_defaults(fn=cmd_fleet)
+
+    chp = sub.add_parser("chaos", help="seeded fault injection + "
+                         "consensus invariant checking (babble_tpu/chaos)")
+    csub = chp.add_subparsers(dest="chaos_cmd", required=True)
+    cl = csub.add_parser("list", help="list the canned scenarios")
+    cl.set_defaults(fn=cmd_chaos)
+    cs = csub.add_parser("show", help="print a scenario as JSON "
+                         "(schema-by-example for custom plans)")
+    cs.add_argument("scenario", help="canned name or scenario JSON path")
+    cs.set_defaults(fn=cmd_chaos)
+    cr = csub.add_parser("run", help="run a scenario and check its "
+                         "invariants (exit 1 on violation)")
+    cr.add_argument("scenario", help="canned name or scenario JSON path")
+    cr.add_argument("--seed", type=int, default=None,
+                    help="override the scenario seed (same seed = "
+                         "bit-identical fault schedule + committed order)")
+    cr.add_argument("--steps", type=int, default=None)
+    cr.add_argument("--nodes", type=int, default=None)
+    cr.add_argument("--json", action="store_true",
+                    help="dump the full result (fault schedule, per-node "
+                         "orders, invariant report) as JSON")
+    cr.add_argument("--live", action="store_true",
+                    help="run against a live subprocess testnet instead "
+                         "of the deterministic in-memory cluster")
+    cr.add_argument("--dir", default="chaos-data",
+                    help="datadir for --live fleets")
+    cr.set_defaults(fn=cmd_chaos)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -126,6 +126,23 @@ class WideHashgraph(TpuHashgraph):
         self._lcr_cache = -1
         self._r_off = 0
 
+    def rebind_registry(self, registry) -> None:
+        """Re-register flush + stage histograms on ``registry`` (called
+        by Core after adopting this engine from a fast-forward snapshot
+        or a checkpoint resume — the restore path builds engines with a
+        private registry, so without the rebind the flush series
+        silently drop off the node's /metrics)."""
+        self.stream.rebind_registry(registry)
+        self._m_flush_events = registry.histogram(
+            "babble_wide_flush_events",
+            "host events drained per wide-engine flush",
+            buckets=SIZE_BUCKETS,
+        )
+        self._m_flush_seconds = registry.histogram(
+            "babble_wide_flush_seconds",
+            "wide-engine flush wall time (pad + device coords phase)",
+        )
+
     # ------------------------------------------------------------------
     # ingest
 

@@ -11,6 +11,7 @@ from .keys import (
     PemKeyFile,
     from_pub_bytes,
     generate_key,
+    key_from_scalar,
     pub_bytes,
     pub_hex,
     sha256,
@@ -22,6 +23,7 @@ __all__ = [
     "KeyPair",
     "PemKeyFile",
     "generate_key",
+    "key_from_scalar",
     "sha256",
     "sign",
     "verify",

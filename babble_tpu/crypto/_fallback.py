@@ -18,6 +18,9 @@ always prefers when importable.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import itertools
 import secrets
 from typing import Optional, Tuple
 
@@ -160,6 +163,23 @@ def generate_private_key() -> FallbackPrivateKey:
 # ----------------------------------------------------------------------
 # ECDSA over a 32-byte SHA-256 digest, raw (r, s) scalars
 
+def _det_nonce(d: int, digest: bytes, counter: int) -> int:
+    """Deterministic ECDSA nonce in [1, N-1]: HMAC-SHA256 keyed by the
+    private scalar over the digest (RFC-6979 in spirit — same security
+    argument: k is a secret PRF of (key, message), so it never repeats
+    across distinct digests and never leaks).  Deterministic signing
+    removes the RNG-failure bug class entirely AND makes signatures —
+    and therefore event identity hashes, which cover (r, s) — a pure
+    function of (key, body): the chaos plane's bit-for-bit scenario
+    reproducibility rests on this."""
+    mac = hmac.new(
+        d.to_bytes(32, "big"),
+        digest + counter.to_bytes(4, "big"),
+        hashlib.sha256,
+    ).digest()
+    return int.from_bytes(mac, "big") % (N - 1) + 1
+
+
 def sign(private: FallbackPrivateKey, digest: bytes) -> Tuple[int, int]:
     if len(digest) != 32:
         # match the hazmat backend (Prehashed(SHA256()) raises on any
@@ -167,8 +187,8 @@ def sign(private: FallbackPrivateKey, digest: bytes) -> Tuple[int, int]:
         raise ValueError(f"expected a 32-byte SHA-256 digest, got "
                          f"{len(digest)} bytes")
     z = int.from_bytes(digest, "big")
-    while True:
-        k = secrets.randbelow(N - 1) + 1
+    for counter in itertools.count():
+        k = _det_nonce(private.d, digest, counter)
         pt = _mul(k, (GX, GY))
         r = pt[0] % N
         if r == 0:

@@ -93,6 +93,24 @@ def generate_key() -> KeyPair:
     return KeyPair(ec.generate_private_key(_CURVE))
 
 
+#: P-256 group order — scalar-derivation helpers (chaos's seeded
+#: identities) need the modulus without reaching into the fallback
+P256_ORDER = _fb.N
+
+
+def key_from_scalar(d: int) -> KeyPair:
+    """Deterministic keypair from a private scalar — chaos scenarios
+    need run-to-run-identical identities from a seed alone.  Always
+    backed by the pure-Python key type (wire-compatible with the hazmat
+    backend, and its signer derives the ECDSA nonce deterministically),
+    so the same scalar yields the same signatures in every environment.
+    Simulation identities only; production keys come from
+    :func:`generate_key`."""
+    if not 1 <= d < _fb.N:
+        raise ValueError("private scalar out of range for P-256")
+    return KeyPair(_fb.FallbackPrivateKey(d))
+
+
 def sign(private: ec.EllipticCurvePrivateKey, digest: bytes) -> Tuple[int, int]:
     """Sign a 32-byte SHA-256 digest; returns raw (r, s) scalars."""
     if isinstance(private, _fb.FallbackPrivateKey):

@@ -29,7 +29,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .crypto.keys import PemKeyFile, generate_key
 from .net.peers import JSONPeers, Peer
-from .testnet import HTTPException, fetch_metrics, fetch_stats
+from .testnet import HTTPException, fetch_metrics, fetch_spans, fetch_stats
 
 GOSSIP_PORT = 1337   # the reference's conventional ports
 SUBMIT_PORT = 1338   # (terraform/scripts/remote-run.sh:12-19)
@@ -199,9 +199,13 @@ def _sweep(layout: HostLayout,
     the second a broken, outdated or misbound service — so every failure
     is classified once, here, for both the /Stats and /metrics sweeps:
 
-    - ``urllib.error.HTTPError`` (a 404 from a pre-telemetry binary, a
-      500ing service): something ANSWERED — ``malformed``, despite
-      HTTPError being an OSError subclass;
+    - ``urllib.error.HTTPError`` 403: the host answered and *declined
+      by policy* (the /debug endpoints are loopback-gated unless the
+      node ran with --allow_remote_debug) — ``gated``, a configuration
+      statement, not a fault;
+    - any other ``urllib.error.HTTPError`` (a 404 from a pre-telemetry
+      binary, a 500ing service): something ANSWERED — ``malformed``,
+      despite HTTPError being an OSError subclass;
     - ``ValueError`` (json.JSONDecodeError) / ``HTTPException`` (garbage
       status line): answered, but not the expected body — ``malformed``;
     - any other ``OSError`` (connect refused / timeout / DNS): nothing
@@ -214,7 +218,10 @@ def _sweep(layout: HostLayout,
         addr = layout.of(i)["service"]
         try:
             rows.append((i, addr, fetch(addr), None, ""))
-        except (urllib.error.HTTPError, ValueError, HTTPException) as e:
+        except urllib.error.HTTPError as e:
+            kind = "gated" if e.code == 403 else "malformed"
+            rows.append((i, addr, None, kind, str(e)))
+        except (ValueError, HTTPException) as e:
             rows.append((i, addr, None, "malformed", str(e)))
         except OSError as e:
             rows.append((i, addr, None, "unreachable", str(e)))
@@ -247,6 +254,24 @@ def scrape_hosts(layout: HostLayout,
             layout, lambda a: fetch_metrics(a, timeout=timeout)):
         if kind is None:
             rows.append({"host": addr, "metrics": text})
+        else:
+            rows.append({"host": addr, "error": err, "kind": kind})
+    return rows
+
+
+def scrape_spans(layout: HostLayout,
+                 timeout: float = 3.0) -> List[Dict[str, object]]:
+    """Fleet-wide /debug/spans sweep (ISSUE 3 satellite: ship span dumps
+    in the fleet sweep — before this, spans were per-node loopback
+    only).  Rows are ``{"host", "spans"}`` on success; failures carry
+    the :func:`_sweep` kind, where a 403 from a loopback-gated host is
+    the distinct ``gated`` kind (expected policy, not an outage) rather
+    than ``unreachable``."""
+    rows = []
+    for _i, addr, spans, kind, err in _sweep(
+            layout, lambda a: fetch_spans(a, timeout=timeout)):
+        if kind is None:
+            rows.append({"host": addr, "spans": spans})
         else:
             rows.append({"host": addr, "error": err, "kind": kind})
     return rows

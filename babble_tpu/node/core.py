@@ -40,6 +40,12 @@ class Core:
         self.key = key
         self.pub_hex = key.pub_hex
         self.participants = participants
+        self.registry = registry
+        # event-timestamp clock, overridable for deterministic replay:
+        # the chaos scenario runner installs a seeded logical clock here
+        # so event bodies (and therefore hashes, signatures and
+        # timestamp-median tie-breaks) are identical across runs
+        self.now_ns: Callable[[], int] = time.time_ns
         if engine is not None:
             # an injected engine is authoritative: the mode flag must
             # match its type, or diff()/head restore would misbehave
@@ -96,6 +102,12 @@ class Core:
                 consensus_window=2 * cache_size if cache_size else None,
             )
         self.byzantine = byzantine
+        if engine is not None:
+            # a checkpoint-restored engine was built before this node's
+            # registry existed: rebind its instruments (wide-engine
+            # flush/stage histograms) or their series silently vanish
+            # from /metrics for the whole resumed run
+            self._rebind_engine_registry()
         # byzantine-mode per-event insert failures (ADVICE r3): counted,
         # not raised — surfaced via insert_failures for stats/tests
         self.insert_failures = 0
@@ -173,6 +185,19 @@ class Core:
 
     # ------------------------------------------------------------------
 
+    def _rebind_engine_registry(self) -> None:
+        """Point the current engine's instruments at this core's
+        registry.  A bootstrap-restored or checkpoint-resumed engine was
+        constructed with a private registry (load_snapshot knows nothing
+        of the node); without this rebind its flush/stage histograms
+        keep observing into that orphan and the series drop off
+        /metrics after every fast-forward engine swap."""
+        if self.registry is None:
+            return
+        rebind = getattr(self.hg, "rebind_registry", None)
+        if rebind is not None:
+            rebind(self.registry)
+
     def bootstrap(self, engine: TpuHashgraph) -> None:
         """Replace the consensus engine with a fast-forward snapshot (the
         catch-up path, node.py): adopt the peer's windowed state and pick
@@ -225,6 +250,7 @@ class Core:
             self.head = ""
             self.seq = -1
             self.init()
+        self._rebind_engine_registry()
 
     def _bootstrap_fork(self, engine) -> None:
         """Byzantine-mode bootstrap (VERDICT r4 missing #5): adopt a
@@ -289,6 +315,7 @@ class Core:
             self.head = ""
             self.seq = -1
             self.init()
+        self._rebind_engine_registry()
 
     def _replay_own_tail(
         self, engine: TpuHashgraph, cid: int, snap_seq: int
@@ -323,7 +350,8 @@ class Core:
 
     def init(self) -> None:
         """Create + insert the node's root event (reference core.go:79-97)."""
-        ev = new_event([], ("", ""), self.key.pub_bytes, 0)
+        ev = new_event([], ("", ""), self.key.pub_bytes, 0,
+                       timestamp=self.now_ns())
         self.sign_and_insert_self_event(ev)
 
     def sign_and_insert_self_event(self, event: Event) -> None:
@@ -442,7 +470,8 @@ class Core:
             self.last_insert_error = "peer head not insertable; merge skipped"
             return False
         ev = new_event(
-            payload, (self.head, other_head), self.key.pub_bytes, self.seq + 1
+            payload, (self.head, other_head), self.key.pub_bytes,
+            self.seq + 1, timestamp=self.now_ns(),
         )
         self.sign_and_insert_self_event(ev)
         return True
@@ -453,7 +482,8 @@ class Core:
         if self.head == "":
             self.init()
         ev = new_event(
-            payload, (self.head, self.head), self.key.pub_bytes, self.seq + 1
+            payload, (self.head, self.head), self.key.pub_bytes,
+            self.seq + 1, timestamp=self.now_ns(),
         )
         self.sign_and_insert_self_event(ev)
 

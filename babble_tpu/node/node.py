@@ -694,6 +694,10 @@ class Node:
             dur = time.perf_counter() - t0
             self._m_commit_latency.observe(dur)
             self.tracer.record("commit_batch", dur, events=len(events))
+            # completion signal for Queue.join() waiters: "queue empty"
+            # alone cannot distinguish drained from batch-in-flight (the
+            # chaos runner samples committed logs only once this fires)
+            self._commit_queue.task_done()
 
     def _random_timeout(self) -> float:
         """Randomized heartbeat pacing (reference node.go:345-351:

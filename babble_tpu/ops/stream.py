@@ -127,6 +127,18 @@ class WideStream:
         )
         self._rr_seen = np.zeros((cfg.e_cap + 1,), bool)  # window rows
 
+    def rebind_registry(self, registry: Registry) -> None:
+        """Re-register the per-stage histograms on ``registry``.  A
+        stream restored from a checkpoint/snapshot was built with a
+        private registry; the owning node rebinds it here so the stage
+        series keep appearing on /metrics after an engine swap."""
+        self.registry = registry
+        self._m_stage = registry.histogram(
+            "babble_wide_stage_seconds",
+            "wide-pipeline stage wall time per call",
+            labelnames=("stage",),
+        )
+
     # ------------------------------------------------------------------
 
     def _tick(self, name: str, t0: float) -> None:

@@ -90,14 +90,22 @@ class TestnetRunner:
     with_clients: bool = True
     ports: PortLayout = field(default_factory=PortLayout)
     extra_node_args: List[str] = field(default_factory=list)
+    #: run fork-aware nodes (accept + detect equivocations) — required
+    #: for crash/restart chaos: an honest node restarting from a stale
+    #: checkpoint reuses sequence numbers and reads as an equivocator
+    byzantine: bool = False
+    #: per-node checkpoint dirs + a tight save interval, so a killed
+    #: node restarts from recent state instead of a fresh root
+    checkpoints: bool = False
+    checkpoint_interval_s: float = 5.0
     # N processes sharing one host must not fight over a single accelerator;
     # set to "" to let each node pick its own default platform.
     jax_platform: str = "cpu"
 
     procs: List[subprocess.Popen] = field(default_factory=list)
+    node_procs: Dict[int, subprocess.Popen] = field(default_factory=dict)
 
-    def start(self) -> None:
-        build_conf(self.base_dir, self.n, self.ports)
+    def _env(self) -> Dict[str, str]:
         env = dict(os.environ)
         if self.jax_platform:
             env["JAX_PLATFORMS"] = self.jax_platform
@@ -108,6 +116,46 @@ class TestnetRunner:
                 # this is set): a down/busy relay would hang every node
                 # at boot, and the relay serializes clients anyway
                 env["PALLAS_AXON_POOL_IPS"] = ""
+        return env
+
+    def _node_args(self, i: int) -> List[str]:
+        p = self.ports.of(i)
+        d = os.path.join(self.base_dir, f"node{i}")
+        args = [
+            sys.executable, "-m", "babble_tpu.cli", "run",
+            "--datadir", d,
+            "--node_addr", p["gossip"],
+            "--proxy_addr", p["submit"],
+            "--client_addr", p["commit"],
+            "--service_addr", p["service"],
+            "--heartbeat", str(self.heartbeat_ms),
+            "--tcp_timeout", str(self.tcp_timeout_ms),
+            "--cache_size", str(self.cache_size),
+            "--log_level", "warning",
+        ] + self.extra_node_args
+        if self.byzantine:
+            args.append("--byzantine")
+        if self.checkpoints:
+            args += ["--checkpoint_dir", os.path.join(d, "ckpt"),
+                     "--checkpoint_interval",
+                     str(self.checkpoint_interval_s)]
+        if not self.with_clients:
+            args.append("--no_client")
+        return args
+
+    def _spawn_node(self, i: int) -> subprocess.Popen:
+        d = os.path.join(self.base_dir, f"node{i}")
+        proc = subprocess.Popen(
+            self._node_args(i), env=self._env(),
+            stdout=open(os.path.join(d, "node.log"), "a"),
+            stderr=subprocess.STDOUT,
+        )
+        self.node_procs[i] = proc
+        return proc
+
+    def start(self) -> None:
+        build_conf(self.base_dir, self.n, self.ports)
+        env = self._env()
         if "--jax_cache" not in self.extra_node_args:
             # one SHARED jit cache for the whole fleet: N same-shape
             # nodes on one host otherwise each pay every compile (on a
@@ -120,25 +168,7 @@ class TestnetRunner:
         for i in range(self.n):
             p = self.ports.of(i)
             d = os.path.join(self.base_dir, f"node{i}")
-            args = [
-                sys.executable, "-m", "babble_tpu.cli", "run",
-                "--datadir", d,
-                "--node_addr", p["gossip"],
-                "--proxy_addr", p["submit"],
-                "--client_addr", p["commit"],
-                "--service_addr", p["service"],
-                "--heartbeat", str(self.heartbeat_ms),
-                "--tcp_timeout", str(self.tcp_timeout_ms),
-                "--cache_size", str(self.cache_size),
-                "--log_level", "warning",
-            ] + self.extra_node_args
-            if not self.with_clients:
-                args.append("--no_client")
-            self.procs.append(subprocess.Popen(
-                args, env=env,
-                stdout=open(os.path.join(d, "node.log"), "w"),
-                stderr=subprocess.STDOUT,
-            ))
+            self.procs.append(self._spawn_node(i))
             if self.with_clients:
                 self.procs.append(subprocess.Popen(
                     [sys.executable, "-m", "babble_tpu.cli", "dummy",
@@ -151,6 +181,28 @@ class TestnetRunner:
                     stderr=subprocess.STDOUT,
                 ))
 
+    def kill_node(self, i: int) -> None:
+        """Hard-stop node i's process (the chaos plane's crash fault;
+        dummy clients stay up, like a real app surviving its node)."""
+        proc = self.node_procs.pop(i, None)
+        if proc is None:
+            return
+        proc.kill()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        if proc in self.procs:
+            self.procs.remove(proc)
+
+    def restart_node(self, i: int) -> None:
+        """Relaunch node i with its original arguments.  Its datadir
+        (key + peers) survives, so the node rejoins under the same
+        identity and catches up through gossip or fast-forward."""
+        if i in self.node_procs:
+            self.kill_node(i)
+        self.procs.append(self._spawn_node(i))
+
     def stop(self) -> None:
         for p in self.procs:
             p.terminate()
@@ -160,6 +212,7 @@ class TestnetRunner:
             except subprocess.TimeoutExpired:
                 p.kill()
         self.procs.clear()
+        self.node_procs.clear()
 
     def __enter__(self) -> "TestnetRunner":
         self.start()
@@ -182,6 +235,17 @@ def fetch_metrics(service_addr: str, timeout: float = 3.0) -> str:
         f"http://{service_addr}/metrics", timeout=timeout
     ) as r:
         return r.read().decode("utf-8", errors="replace")
+
+
+def fetch_spans(service_addr: str, timeout: float = 3.0) -> Dict:
+    """One node's span-tracer dump (service /debug/spans: capacity,
+    dropped, parent/child trees).  Loopback-gated by default — a
+    non-local sweep gets a 403, which fleet.scrape_spans classifies as
+    the distinct ``gated`` failure kind."""
+    with urllib.request.urlopen(
+        f"http://{service_addr}/debug/spans", timeout=timeout
+    ) as r:
+        return json.load(r)
 
 
 def watch_once(n: int, ports: Optional[PortLayout] = None) -> List[Dict[str, str]]:

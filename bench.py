@@ -201,6 +201,72 @@ V5E_PEAK_HBM_BPS = 819e9
 DETAIL: dict = {}   # accumulated per-config detail -> BENCH_DETAIL.json
 
 
+def registry_diff(before: dict, after: dict) -> dict:
+    """Diff two ``Registry.snapshot()`` dumps into a per-phase
+    attribution table (ISSUE 3 satellite / ROADMAP telemetry leftover):
+    counter deltas plus histogram count/sum deltas, each histogram row
+    carrying its share of the total histogram-seconds between the two
+    snapshots — "where did the wall time of THIS phase go", which the
+    cumulative totals alone cannot answer.
+
+    Gauges are point-in-time and excluded.  Returns
+    ``{"rows": [...], "total_hist_sum": s}`` with rows sorted by
+    ``delta_sum`` (histograms) then ``delta`` (counters), descending."""
+    def _index(fam):
+        return {
+            tuple(sorted(s["labels"].items())): s
+            for s in fam.get("series", [])
+        }
+
+    rows = []
+    for name in sorted(after):
+        fam = after[name]
+        prev = _index(before.get(name, {}))
+        for s in fam.get("series", []):
+            key = tuple(sorted(s["labels"].items()))
+            b = prev.get(key, {})
+            if fam["kind"] == "counter":
+                d = s.get("value", 0.0) - b.get("value", 0.0)
+                if d:
+                    rows.append({"metric": name, "labels": s["labels"],
+                                 "kind": "counter", "delta": d})
+            elif fam["kind"] == "histogram":
+                dc = s.get("count", 0) - b.get("count", 0)
+                ds = s.get("sum", 0.0) - b.get("sum", 0.0)
+                if dc:
+                    rows.append({"metric": name, "labels": s["labels"],
+                                 "kind": "histogram",
+                                 "delta_count": dc,
+                                 "delta_sum": round(ds, 6)})
+    total = sum(r["delta_sum"] for r in rows if r["kind"] == "histogram")
+    for r in rows:
+        if r["kind"] == "histogram" and total > 0:
+            r["share"] = round(r["delta_sum"] / total, 4)
+    rows.sort(key=lambda r: (-(r.get("delta_sum", 0.0)),
+                             -(r.get("delta", 0.0))))
+    return {"rows": rows, "total_hist_sum": round(total, 6)}
+
+
+def format_attribution(diff: dict) -> str:
+    """The registry_diff as an aligned text table for stderr logs."""
+    lines = [f"{'metric':<44} {'labels':<18} "
+             f"{'count':>8} {'sum_s':>10} {'share':>6}"]
+    for r in diff["rows"]:
+        labels = ",".join(f"{k}={v}" for k, v in sorted(r["labels"].items()))
+        if r["kind"] == "histogram":
+            lines.append(
+                f"{r['metric']:<44} {labels:<18} "
+                f"{r['delta_count']:>8} {r['delta_sum']:>10.4f} "
+                f"{r.get('share', 0.0):>6.1%}"
+            )
+        else:
+            lines.append(
+                f"{r['metric']:<44} {labels:<18} "
+                f"{r['delta']:>8.0f} {'-':>10} {'-':>6}"
+            )
+    return "\n".join(lines)
+
+
 def _roofline(flops, bytes_, seconds, unit="int8_ops"):
     """Achieved vs peak on both roofline axes; the phase is bound by
     whichever fraction is higher."""
@@ -915,6 +981,7 @@ def run_10k(n: int = 10_000, e: int = 1_000_000,
     # tests/test_stream.py; opt-in until TPU-measured at this scale
     stacked = os.environ.get("BENCH_10K_STACKED") == "1"
     registry = Registry()   # per-stage distributions ride the artifact
+    snap0 = registry.snapshot()   # pre-run anchor for the phase diff
     stream = stream_consensus(
         cfg, dag, batch_events=batch, round_margin=0, seq_window=48,
         compact_min=4096, record_ordered=False, log=log,
@@ -954,6 +1021,12 @@ def run_10k(n: int = 10_000, e: int = 1_000_000,
         # the distribution evidence the cumulative phase_s totals lack
         "metrics": registry.snapshot(),
     }
+    # per-phase attribution (ISSUE 3 satellite): the snapshot DELTA over
+    # this run, as counter deltas + histogram count/sum deltas with
+    # share-of-total — where this config's wall time actually went
+    detail["metrics_delta"] = registry_diff(snap0, registry.snapshot())
+    log(f"[{tag}] phase attribution:\n"
+        + format_attribution(detail["metrics_delta"]))
     log(f"[{tag}] total {total:.1f}s; ordered {stream.ordered_total}/{e} "
         f"(lcr {stream.lcr}, max_round {detail['max_round']}); "
         f"phases {detail['phase_s']}")

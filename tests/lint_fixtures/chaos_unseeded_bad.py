@@ -1,0 +1,36 @@
+"""Fixture for chaos-unseeded-random: global-RNG draws in chaos code.
+
+The filename carries the ``chaos`` segment that puts this file in the
+rule's scope; the seeded idioms at the bottom must NOT be flagged.
+"""
+
+import random
+from random import choice, random as rand
+
+
+def decide_drop(p):
+    return random.random() < p          # MARK: chaos-unseeded-random
+
+
+def pick_peer(peers):
+    random.shuffle(peers)               # MARK: chaos-unseeded-random
+    return choice(peers)                # MARK: chaos-unseeded-random
+
+
+def jitter_ms():
+    return rand() * 10.0                # MARK: chaos-unseeded-random
+
+
+def make_rng():
+    return random.Random()              # MARK: chaos-unseeded-random
+
+
+# ---- the correct, seeded idioms: not flagged ----
+
+
+def seeded_decide_drop(rng: random.Random, p: float) -> bool:
+    return rng.random() < p
+
+
+def seeded_rng(seed: int, src: int, dst: int) -> random.Random:
+    return random.Random(f"chaos:{seed}:{src}>{dst}")

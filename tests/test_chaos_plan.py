@@ -1,0 +1,258 @@
+"""Chaos plane data layer: plans, injector determinism, invariants.
+
+These are the stdlib-fast tests (no cluster): the scenario JSON schema
+round-trips, validation refuses out-of-range plans, the injector's
+per-link fault streams are pure functions of (plan, seed), and the
+invariant checker's sequence algebra (prefix / contiguous-sublist /
+window-overlap) flags exactly the divergences it should.
+"""
+
+import json
+
+import pytest
+
+from babble_tpu.chaos.injector import FaultInjector, OutboundFaults
+from babble_tpu.chaos.invariants import (
+    InvariantChecker,
+    _is_contiguous_sublist,
+    _is_prefix,
+    _windows_agree,
+)
+from babble_tpu.chaos.plan import (
+    ByzantineSpec,
+    FaultPlan,
+    LinkFaults,
+    LinkOverride,
+    Partition,
+    Scenario,
+)
+from babble_tpu.chaos.scenario import ScenarioResult, deterministic_keys
+from babble_tpu.chaos.scenarios import CANNED, canned_names, load_scenario
+
+
+# ----------------------------------------------------------------------
+# plan model
+
+def test_scenario_json_roundtrip_all_canned():
+    for name in canned_names():
+        sc = load_scenario(name)
+        back = Scenario.from_dict(json.loads(json.dumps(sc.to_dict())))
+        assert back.to_dict() == sc.to_dict(), name
+
+
+def test_link_faults_validation():
+    with pytest.raises(ValueError, match="probability"):
+        LinkFaults(drop=1.5)
+    with pytest.raises(ValueError, match="delay_ms"):
+        LinkFaults(delay_ms=(5, 1))
+    with pytest.raises(ValueError, match="unknown link fault"):
+        LinkFaults.from_dict({"drpo": 0.1})
+
+
+def test_plan_validation_bounds():
+    plan = FaultPlan(partitions=[Partition(group=(3,), start=0, heal=10)])
+    with pytest.raises(ValueError, match="out of range"):
+        plan.validate(3)
+    plan.validate(4)
+    # a partition that swallows every node leaves no one to disagree with
+    with pytest.raises(ValueError, match="leave someone outside"):
+        FaultPlan(
+            partitions=[Partition(group=(0, 1), start=0)]
+        ).validate(2)
+    with pytest.raises(ValueError, match="heal"):
+        Partition(group=(0,), start=10, heal=10)
+    with pytest.raises(ValueError, match="mode"):
+        ByzantineSpec(node=0, mode="evil")
+    with pytest.raises(ValueError, match="unknown invariants"):
+        Scenario(name="x", invariants=("no_such",))
+
+
+def test_link_override_resolution():
+    slow = LinkFaults(delay=1.0, delay_ms=(2, 4))
+    plan = FaultPlan(overrides=[
+        LinkOverride(faults=slow, src=2),
+        LinkOverride(faults=LinkFaults(drop=1.0), src=2, dst=0),
+    ])
+    assert plan.link(2, 1) == slow          # src-wide override
+    assert plan.link(2, 0).drop == 1.0      # exact link wins (listed last)
+    assert plan.link(1, 2) == plan.default  # untouched direction
+
+
+def test_partition_separates_by_group_and_tick():
+    p = Partition(group=(0, 1), start=10, heal=20)
+    assert not p.separates(0, 2, 5)     # not started
+    assert p.separates(0, 2, 10)        # across the cut
+    assert p.separates(2, 1, 15)        # both directions
+    assert not p.separates(0, 1, 15)    # same side
+    assert not p.separates(0, 2, 20)    # healed
+
+
+# ----------------------------------------------------------------------
+# injector
+
+def test_injector_streams_are_seed_deterministic():
+    plan = FaultPlan(default=LinkFaults(
+        drop=0.3, delay=0.3, duplicate=0.3, reorder=0.3,
+    ))
+
+    def draw(seed, n=64):
+        inj = FaultInjector(plan, seed)
+        return [inj.outbound(0, 1) for _ in range(n)], \
+            inj.schedule_fingerprint()
+
+    a, fp_a = draw(42)
+    b, fp_b = draw(42)
+    assert a == b and fp_a == fp_b
+    c, _ = draw(43)
+    assert a != c, "different seeds must differ"
+
+
+def test_injector_per_link_streams_are_interleaving_independent():
+    """The k-th attempt on a link sees the same decision no matter how
+    attempts on OTHER links interleave — the property that keeps live
+    fault schedules reproducible."""
+    plan = FaultPlan(default=LinkFaults(drop=0.5, duplicate=0.5))
+    inj1 = FaultInjector(plan, 9)
+    seq_a = [inj1.outbound(0, 1) for _ in range(20)]
+    inj2 = FaultInjector(plan, 9)
+    seq_b = []
+    for i in range(20):
+        inj2.outbound(1, 0)       # traffic on another link, interleaved
+        seq_b.append(inj2.outbound(0, 1))
+        inj2.outbound(2, 1)
+    assert seq_a == seq_b
+
+
+def test_injector_quiesce_and_partitions():
+    plan = FaultPlan(
+        default=LinkFaults(drop=1.0),
+        partitions=[Partition(group=(1,), start=5, heal=9)],
+    )
+    inj = FaultInjector(plan, 1)
+    inj.advance_to(0)
+    assert not inj.link_blocked(0, 1)
+    assert inj.outbound(0, 1).drop
+    inj.advance_to(5)
+    assert inj.link_blocked(0, 1) and inj.link_blocked(1, 0)
+    assert not inj.link_blocked(0, 2)
+    inj.advance_to(9)
+    assert not inj.link_blocked(0, 1)
+    inj.quiesce = True
+    assert inj.outbound(0, 1) == OutboundFaults()   # no faults drawn
+
+
+def test_stale_replay_gating():
+    plan = FaultPlan(byzantine=ByzantineSpec(
+        node=1, mode="stale_replay", at=10, prob=1.0,
+    ))
+    inj = FaultInjector(plan, 3)
+    inj.advance_to(0)
+    assert not inj.stale_replay(1)      # before activation
+    assert not inj.stale_replay(0)      # wrong node
+    inj.advance_to(10)
+    assert inj.stale_replay(1)
+    assert not inj.is_stale_replayer(0)
+
+
+# ----------------------------------------------------------------------
+# deterministic identities
+
+def test_deterministic_keys_stable_and_sorted():
+    a = deterministic_keys(7, 4)
+    b = deterministic_keys(7, 4)
+    assert [k.pub_hex for k in a] == [k.pub_hex for k in b]
+    assert [k.pub_hex for k in a] == sorted(k.pub_hex for k in a)
+    assert len({k.pub_hex for k in a}) == 4
+    c = deterministic_keys(8, 4)
+    assert {k.pub_hex for k in a} != {k.pub_hex for k in c}
+
+
+def test_deterministic_signatures():
+    """Event identity hashes cover (r, s): reproducible committed order
+    requires the signer itself to be deterministic."""
+    key = deterministic_keys(7, 1)[0]
+    digest = b"\x11" * 32
+    assert key.sign_digest(digest) == key.sign_digest(digest)
+
+
+# ----------------------------------------------------------------------
+# invariant algebra + checker
+
+def test_sequence_algebra():
+    assert _is_prefix([1, 2], [1, 2, 3])
+    assert not _is_prefix([1, 9], [1, 2, 3])
+    assert _is_contiguous_sublist([2, 3], [1, 2, 3, 4])
+    assert not _is_contiguous_sublist([2, 4], [1, 2, 3, 4])
+    assert _is_contiguous_sublist([], [1])
+    # rolling windows of one log: overlap agreement
+    assert _windows_agree([3, 4, 5], [1, 2, 3, 4])
+    assert _windows_agree([1, 2, 3], [3, 4])
+    assert not _windows_agree([3, 9], [1, 2, 3, 4])
+    assert _windows_agree([7, 8], [1, 2])   # disjoint: unfalsifiable
+    # shared elements with misaligned heads ARE a disagreement
+    assert not _windows_agree([9, 2], [1, 2, 3])
+
+
+def _result(**kw) -> ScenarioResult:
+    base = dict(
+        name="t", seed=0, steps=10,
+        committed={0: ["a", "b"], 1: ["a", "b"]},
+        consensus={0: ["x"], 1: ["x"]},
+        honest=[0, 1], alive={0, 1},
+        consensus_counts_final={0: 5, 1: 5},
+        fork_detected={0: True, 1: True},
+    )
+    base.update(kw)
+    r = ScenarioResult(name="t", seed=0, steps=10)
+    for k, v in base.items():
+        setattr(r, k, v)
+    return r
+
+
+def test_checker_flags_order_divergence():
+    sc = Scenario(name="t", nodes=2, invariants=("prefix_agreement",))
+    ok = InvariantChecker().check(sc, _result())
+    assert ok.ok
+    bad = InvariantChecker().check(
+        sc, _result(committed={0: ["a", "b"], 1: ["a", "c"]})
+    )
+    assert not bad.ok
+    assert "diverge at commit #1" in bad.violations[0].detail
+
+
+def test_checker_flags_missing_fork_detection():
+    sc = Scenario(
+        name="t", nodes=3,
+        invariants=("fork_detected",),
+        plan=FaultPlan(byzantine=ByzantineSpec(node=2, mode="fork")),
+    )
+    ok = InvariantChecker().check(
+        sc, _result(honest=[0, 1], fork_detected={0: True, 1: True})
+    )
+    assert ok.ok
+    bad = InvariantChecker().check(
+        sc, _result(honest=[0, 1], fork_detected={0: True, 1: False})
+    )
+    assert not bad.ok and bad.violations[0].invariant == "fork_detected"
+
+
+def test_checker_liveness_uses_heal_window():
+    sc = Scenario(name="t", nodes=2, invariants=("liveness",),
+                  liveness_bound=50)
+    stalled = _result(
+        heal_tick=100,
+        consensus_counts_at_heal={0: 5, 1: 5},
+        consensus_counts_at_bound={0: 9, 1: 5},
+    )
+    rep = InvariantChecker().check(sc, stalled)
+    assert not rep.ok
+    assert "node 1" in rep.violations[0].detail
+
+
+def test_canned_catalog_covers_issue_list():
+    assert {"flaky-link", "minority-partition",
+            "crash-restart-with-fast-forward", "fork-attack",
+            "slow-peer"} <= set(CANNED)
+    for name, spec in CANNED.items():
+        sc = Scenario.from_dict(spec)   # validates
+        assert sc.name == name

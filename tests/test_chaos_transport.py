@@ -1,0 +1,213 @@
+"""FaultyTransport over the in-memory network: wire-level fault tests.
+
+Includes the ISSUE 3 satellite: under concurrent faulted links with
+reorder AND duplicate enabled, delivery never hands an RPC response to
+the wrong waiter — every concurrent sync gets the answer to exactly the
+request it sent.
+"""
+
+import asyncio
+
+import pytest
+
+from babble_tpu.chaos import (
+    ByzantineSpec,
+    FaultInjector,
+    FaultPlan,
+    FaultyTransport,
+    LinkFaults,
+    Partition,
+)
+from babble_tpu.net.commands import SyncRequest, SyncResponse
+from babble_tpu.net.inmem_transport import InmemNetwork
+from babble_tpu.net.transport import TransportError
+from babble_tpu.obs import Registry
+
+
+def _pair(plan, seed=1):
+    """Two wrapped transports on one network + their shared injector."""
+    net = InmemNetwork()
+    addrs = ["inmem://t0", "inmem://t1"]
+    idx = {a: i for i, a in enumerate(addrs)}
+    inj = FaultInjector(plan, seed)
+    t0 = FaultyTransport(net.transport(addrs[0]), inj, 0, idx)
+    t1 = FaultyTransport(net.transport(addrs[1]), inj, 1, idx)
+    return net, inj, t0, t1, addrs
+
+
+def _echo_server(transport, seen):
+    """Serve every inbound sync with a response echoing the request's
+    known map — lets a client verify it got ITS answer."""
+    async def loop():
+        while True:
+            rpc = await transport.consumer.get()
+            seen.append(rpc.command)
+            rpc.respond(SyncResponse(
+                from_addr=transport.local_addr(),
+                head=repr(sorted(rpc.command.known.items())),
+                events=[],
+            ))
+    return asyncio.ensure_future(loop())
+
+
+def test_drop_and_partition_raise_transport_error():
+    async def go():
+        plan = FaultPlan(
+            default=LinkFaults(drop=1.0),
+            partitions=[Partition(group=(1,), start=10, heal=20)],
+        )
+        net, inj, t0, t1, addrs = _pair(plan)
+        with pytest.raises(TransportError, match="chaos: dropped"):
+            await t0.sync(addrs[1], SyncRequest(addrs[0], {}))
+        inj.advance_to(10)
+        with pytest.raises(TransportError, match="partitioned"):
+            await t0.sync(addrs[1], SyncRequest(addrs[0], {}))
+        await t0.close()
+        await t1.close()
+
+    asyncio.run(go())
+
+
+def test_inbound_partition_enforced_by_receiver_pump():
+    """A partitioned sender whose OWN clock lags still cannot get a
+    message through: the receiving side's pump checks the link too."""
+    async def go():
+        plan = FaultPlan(
+            partitions=[Partition(group=(1,), start=0, heal=None)],
+        )
+        net, inj, t0, t1, addrs = _pair(plan)
+        seen = []
+        server = _echo_server(t1, seen)     # consumer -> pump starts
+        await asyncio.sleep(0)
+        # bypass t0's outbound check: send via the raw inner transport
+        with pytest.raises(TransportError, match="partitioned"):
+            await t0.inner.sync(addrs[1], SyncRequest(addrs[0], {}))
+        assert seen == [], "the node must never see the partitioned RPC"
+        server.cancel()
+        await t0.close()
+        await t1.close()
+
+    asyncio.run(go())
+
+
+def test_duplicate_delivers_twice_but_responds_once():
+    async def go():
+        plan = FaultPlan(default=LinkFaults(duplicate=1.0))
+        net, inj, t0, t1, addrs = _pair(plan)
+        seen = []
+        server = _echo_server(t1, seen)
+        resp = await t0.sync(addrs[1], SyncRequest(addrs[0], {0: 7}))
+        assert resp.head == repr([(0, 7)])
+        await asyncio.sleep(0.05)           # let the shadow copy land
+        assert len(seen) == 2, "duplicate fault must deliver two copies"
+        server.cancel()
+        await t0.close()
+        await t1.close()
+
+    asyncio.run(go())
+
+
+def test_concurrent_reorder_duplicate_never_crosses_responses():
+    """ISSUE 3 satellite: with reorder+duplicate both enabled and many
+    syncs in flight, each waiter gets the response to its own request —
+    responses are never delivered to the wrong future."""
+    async def go():
+        plan = FaultPlan(default=LinkFaults(
+            duplicate=0.7, reorder=0.7, reorder_ms=(0.1, 3.0),
+            delay=0.5, delay_ms=(0.1, 2.0),
+        ))
+        net, inj, t0, t1, addrs = _pair(plan)
+        seen = []
+        server = _echo_server(t1, seen)
+
+        async def one(i):
+            resp = await t0.sync(
+                addrs[1], SyncRequest(addrs[0], {0: i}), timeout=10.0
+            )
+            assert resp.head == repr([(0, i)]), \
+                f"waiter {i} got someone else's response: {resp.head}"
+
+        await asyncio.gather(*(one(i) for i in range(40)))
+        assert len(seen) >= 40
+        server.cancel()
+        await t0.close()
+        await t1.close()
+
+    asyncio.run(go())
+
+
+def test_stale_replay_answers_from_cache():
+    async def go():
+        plan = FaultPlan(byzantine=ByzantineSpec(
+            node=1, mode="stale_replay", at=0, prob=1.0,
+        ))
+        net, inj, t0, t1, addrs = _pair(plan)
+        served = []
+
+        async def server_loop():
+            n = 0
+            while True:
+                rpc = await t1.consumer.get()
+                served.append(rpc.command)
+                n += 1
+                rpc.respond(SyncResponse(
+                    from_addr=addrs[1], head=f"fresh-{n}", events=[],
+                ))
+        server = asyncio.ensure_future(server_loop())
+
+        first = await t0.sync(addrs[1], SyncRequest(addrs[0], {0: 1}))
+        assert first.head == "fresh-1"      # cache empty: passes through
+        second = await t0.sync(addrs[1], SyncRequest(addrs[0], {0: 2}))
+        assert second.head == "fresh-1", "replayer must serve stale state"
+        assert len(served) == 1, "the node never saw the second sync"
+        server.cancel()
+        await t0.close()
+        await t1.close()
+
+    asyncio.run(go())
+
+
+def test_instrument_rehomes_chaos_counters():
+    async def go():
+        plan = FaultPlan(default=LinkFaults(drop=1.0))
+        net, inj, t0, t1, addrs = _pair(plan)
+        reg = Registry()
+        t0.instrument(reg)
+        fam = reg.get("babble_chaos_faults_total")
+        assert fam is not None
+        assert fam.labels("drop").value == 0
+        with pytest.raises(TransportError):
+            await t0.sync(addrs[1], SyncRequest(addrs[0], {}))
+        assert fam.labels("drop").value == 1
+        # pre-created children: every kind is a visible series from boot
+        exposition = reg.exposition()
+        for kind in ("drop", "delay", "duplicate", "reorder",
+                     "partition", "stale_replay"):
+            assert f'kind="{kind}"' in exposition
+        await t0.close()
+        await t1.close()
+
+    asyncio.run(go())
+
+
+def test_too_late_marker_survives_the_pump():
+    """The fast-forward trigger is a string prefix on the error; the
+    stale-replay pump's relay must not rewrite it."""
+    async def go():
+        plan = FaultPlan(byzantine=ByzantineSpec(
+            node=1, mode="stale_replay", at=0, prob=0.0,
+        ))
+        net, inj, t0, t1, addrs = _pair(plan)
+
+        async def too_late_server():
+            while True:
+                rpc = await t1.consumer.get()
+                rpc.respond(None, error="too_late: window moved")
+        server = asyncio.ensure_future(too_late_server())
+        with pytest.raises(TransportError, match="^too_late"):
+            await t0.sync(addrs[1], SyncRequest(addrs[0], {}))
+        server.cancel()
+        await t0.close()
+        await t1.close()
+
+    asyncio.run(go())

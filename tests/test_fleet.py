@@ -175,3 +175,94 @@ def test_scrape_hosts_returns_metrics_text_and_failure_kinds():
         assert rows[0]["kind"] == "malformed", rows
     finally:
         err.shutdown()
+
+
+# ----------------------------------------------------------------------
+# /debug/spans sweep (ISSUE 3 satellite: span dumps in the fleet sweep)
+
+_SPANS_BODY = json.dumps({
+    "capacity": 1024, "dropped": 0,
+    "trees": [{"name": "gossip", "id": 1, "parent": None,
+               "start": 0.0, "dur_s": 0.01, "children": []}],
+}).encode()
+
+
+class _SpansStub(BaseHTTPRequestHandler):
+    """A host serving /debug/spans ungated (--allow_remote_debug)."""
+
+    def do_GET(self):
+        if self.path == "/debug/spans":
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(_SPANS_BODY)))
+            self.end_headers()
+            self.wfile.write(_SPANS_BODY)
+        else:
+            self.send_error(404)
+
+    def log_message(self, *a):
+        pass
+
+
+class _GatedStub(BaseHTTPRequestHandler):
+    """A loopback-gated host: /debug/* answers 403 to this sweep."""
+
+    def do_GET(self):
+        self.send_error(403, "debug endpoints are loopback-only")
+
+    def log_message(self, *a):
+        pass
+
+
+def test_scrape_spans_returns_trees_and_classifies_gated():
+    from babble_tpu.fleet import scrape_spans
+
+    srv = _stub_server(_SpansStub)
+    try:
+        rows = scrape_spans(
+            HostLayout(["127.0.0.1"], service_port=srv.server_port)
+        )
+        assert rows[0]["spans"]["trees"][0]["name"] == "gossip"
+    finally:
+        srv.shutdown()
+    # a 403 is the node's loopback gate speaking: a DISTINCT 'gated'
+    # kind, not 'unreachable' (the host answered) nor plain 'malformed'
+    gated = _stub_server(_GatedStub)
+    try:
+        rows = scrape_spans(
+            HostLayout(["127.0.0.1"], service_port=gated.server_port)
+        )
+        assert rows[0]["kind"] == "gated", rows
+        assert "403" in rows[0]["error"]
+    finally:
+        gated.shutdown()
+    # nothing listening at all stays 'unreachable'
+    rows = scrape_spans(HostLayout(["127.0.0.1"], service_port=_free_port()))
+    assert rows[0]["kind"] == "unreachable"
+
+
+def test_fleet_scrape_cli_spans_mode(tmp_path):
+    """`fleet scrape --spans` merges metrics + spans rows as JSON; a
+    gated spans row does not flip the exit code (expected policy), a
+    missing metrics blob does."""
+    import subprocess
+    import sys
+
+    srv = _stub_server()          # valid /metrics, no /debug/spans (404)
+    hosts = os.path.join(str(tmp_path), "hosts.txt")
+    with open(hosts, "w") as f:
+        f.write("127.0.0.1\n")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "babble_tpu.cli", "fleet", "scrape",
+             "--hosts", hosts, "--service_port", str(srv.server_port),
+             "--spans"],
+            capture_output=True, text=True, timeout=60,
+        )
+        rows = json.loads(proc.stdout)
+        assert rows[0]["metrics"] == _METRICS_TEXT
+        # the stub 404s /debug/spans -> malformed, which DOES fail
+        assert rows[0]["spans_kind"] == "malformed"
+        assert proc.returncode == 1
+    finally:
+        srv.shutdown()

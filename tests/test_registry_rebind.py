@@ -1,0 +1,104 @@
+"""ROADMAP leftover (ISSUE 3 satellite): wide-engine flush histograms
+must survive a fast-forward engine swap.
+
+A bootstrap-restored (or checkpoint-resumed) WideHashgraph is built by
+the store layer with a private registry; before the rebind, its flush
+and stage histograms kept observing into that orphan and the series
+silently dropped off the node's /metrics.  Core now rebinds the
+engine's instruments onto its own registry on every engine adoption.
+"""
+
+import pytest
+
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.node.core import Core
+from babble_tpu.obs import Registry
+from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+_PATTERN = [(0, 1), (1, 2), (2, 0), (1, 0), (2, 1), (0, 2)]
+
+
+def _wide_cores(registry):
+    """Three wide cores; core 0 carries the node registry under test."""
+    keys = sorted([generate_key() for _ in range(3)],
+                  key=lambda k: k.pub_hex)
+    parts = {k.pub_hex: i for i, k in enumerate(keys)}
+    cores = [
+        Core(i, keys[i], parts, cache_size=64, wide=True,
+             wide_caps=(256, 64, 32),
+             registry=registry if i == 0 else None)
+        for i in range(3)
+    ]
+    for c in cores:
+        c.init()
+    return keys, parts, cores
+
+
+def _gossip_rounds(cores, rounds=2):
+    for r in range(rounds):
+        for i, (a, b) in enumerate(_PATTERN):
+            known = cores[b].known()
+            diff = cores[a].diff(known)
+            cores[b].sync(cores[a].head, cores[a].to_wire(diff),
+                          [f"tx{r}-{i}".encode()])
+        for c in cores:
+            c.run_consensus()   # drives flush -> observes histograms
+
+
+def test_wide_flush_series_survive_fast_forward_engine_swap():
+    reg = Registry()
+    keys, parts, cores = _wide_cores(reg)
+    _gossip_rounds(cores)
+    fam = reg.get("babble_wide_flush_seconds")
+    stage = reg.get("babble_wide_stage_seconds")
+    assert fam is not None and fam.count > 0
+    assert stage is not None
+
+    snap = snapshot_bytes(cores[0].hg)
+    restored = load_snapshot(snap)
+    # the restore path builds its own private registry — the exact
+    # regression: without the rebind, post-swap flushes vanish
+    assert restored.stream.registry is not reg
+
+    before = fam.count
+    cores[0].bootstrap(restored)
+    assert cores[0].hg is restored
+    assert restored.stream.registry is reg, "bootstrap must rebind"
+    _gossip_rounds(cores)
+    assert fam.count > before, (
+        "flush series stopped observing on the node registry after the "
+        "fast-forward engine swap"
+    )
+    # same family object still served by exposition (no duplicate)
+    expo = reg.exposition()
+    assert expo.count("# TYPE babble_wide_flush_seconds histogram") == 1
+
+
+def test_wide_engine_injected_at_boot_is_rebound():
+    """The checkpoint-resume path: an engine built before the node's
+    registry existed is rebound in Core.__init__."""
+    keys, parts, cores = _wide_cores(None)
+    _gossip_rounds(cores)
+    restored = load_snapshot(snapshot_bytes(cores[0].hg))
+
+    reg = Registry()
+    resumed = Core(0, keys[0], parts, engine=restored, registry=reg)
+    assert restored.stream.registry is reg
+    resumed.add_self_event([b"resume-tx"])
+    resumed.run_consensus()
+    fam = reg.get("babble_wide_flush_seconds")
+    assert fam is not None and fam.count > 0
+
+
+def test_rebind_bucket_layouts_stay_consistent():
+    """The rebound histograms re-register under the same names with the
+    same bucket layouts — a mismatch would raise (Registry guards
+    against silently collapsing a distribution)."""
+    reg = Registry()
+    keys, parts, cores = _wide_cores(reg)
+    _gossip_rounds(cores, rounds=1)
+    restored = load_snapshot(snapshot_bytes(cores[0].hg))
+    cores[0].bootstrap(restored)    # must not raise on re-registration
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("babble_wide_flush_events", "clash",
+                      buckets=(1.0, 2.0))

@@ -60,9 +60,11 @@ def test_rule_catalog_well_formed():
         assert " " not in r.name, f"rule name {r.name!r} is not a slug"
         assert r.description, f"rule {r.name} has no description"
     # the ISSUE-1 rule families plus the ISSUE-2 blocking-call rule
+    # and the ISSUE-3 chaos-reproducibility rule
     assert {"jit-traced-branch", "jit-host-sync", "jit-unhashable-static",
             "await-state-race", "asyncio-blocking-call",
-            "drain-before-validate", "falsy-or-fallback"} <= set(names)
+            "drain-before-validate", "falsy-or-fallback",
+            "chaos-unseeded-random"} <= set(names)
 
 
 def test_every_suppression_in_tree_names_a_rule():
@@ -144,6 +146,32 @@ def test_invariants_fixture_findings():
     assert len(findings) == 2
 
 
+def test_chaos_randomness_fixture_findings():
+    """ISSUE 3 satellite: chaos code paths must carry no unseeded
+    global-RNG draws — reproducibility from --seed is the whole
+    contract.  The seeded idioms at the fixture's bottom stay clean."""
+    path = _fixture("chaos_unseeded_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "chaos-unseeded-random") == _marked_lines(
+        path, "chaos-unseeded-random"
+    ), [f.format() for f in findings]
+    assert len(findings) == 5, [f.format() for f in findings]
+
+
+def test_chaos_randomness_rule_is_path_scoped():
+    """The same source outside a chaos path is not in scope — node.py's
+    heartbeat jitter is allowed its global random.random()."""
+    from babble_tpu.analysis.randomness import ChaosUnseededRandomRule
+    from babble_tpu.analysis.engine import FileContext
+
+    src = "import random\n\ndef f():\n    return random.random()\n"
+    rule = ChaosUnseededRandomRule()
+    in_scope = list(rule.check(FileContext("pkg/chaos/injector.py", src)))
+    assert len(in_scope) == 1
+    out_of_scope = list(rule.check(FileContext("pkg/node/node.py", src)))
+    assert out_of_scope == []
+
+
 def test_named_suppression_is_honored():
     findings = check_file(_fixture("suppressed_ok.py"), ALL_RULES,
                           known_rules=RULE_NAMES)
@@ -181,7 +209,7 @@ def test_cli_exits_nonzero_with_locations_on_fixtures():
     for rule in ("jit-traced-branch", "jit-host-sync",
                  "jit-unhashable-static", "await-state-race",
                  "asyncio-blocking-call", "drain-before-validate",
-                 "falsy-or-fallback"):
+                 "falsy-or-fallback", "chaos-unseeded-random"):
         assert rule in proc.stdout, (rule, proc.stdout)
     import re
 

@@ -95,3 +95,62 @@ def test_testnet_end_to_end(tmp_path):
         assert k >= sent, f"app logs lag: {[len(l) for l in logs]} < {sent}"
         for l in logs[1:]:
             assert l[:k] == logs[0][:k]
+
+
+def test_testnet_runner_chaos_and_checkpoint_args(tmp_path):
+    """The live chaos plumbing: per-node args carry the shared chaos
+    plan, byzantine mode and checkpoint knobs; restart_node respawns
+    with the same identity."""
+    from babble_tpu.testnet import TestnetRunner
+
+    r = TestnetRunner(
+        str(tmp_path), 3, byzantine=True, checkpoints=True,
+        checkpoint_interval_s=5.0,
+        extra_node_args=["--chaos_plan", "plan.json", "--chaos_seed", "9"],
+    )
+    args = r._node_args(1)
+    assert "--byzantine" in args
+    assert "--chaos_plan" in args and "plan.json" in args
+    assert "--chaos_seed" in args and "9" in args
+    i = args.index("--checkpoint_dir")
+    assert args[i + 1].endswith(os.path.join("node1", "ckpt"))
+    assert "--checkpoint_interval" in args
+    # restart reuses the datadir (same key + peers -> same identity)
+    assert args[args.index("--datadir") + 1].endswith("node1")
+
+
+def test_cli_chaos_wrap_derives_link_identity_from_peers(tmp_path):
+    """`babble-tpu run --chaos_plan`: every node derives its own link id
+    and the addr->id map from the canonical peer order, so a fleet
+    shares one (plan, seed) with no per-node flags."""
+    import argparse
+    import json as _json
+
+    from babble_tpu.chaos import FaultyTransport, load_scenario
+    from babble_tpu.cli import _chaos_wrap
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.net.peers import Peer
+
+    plan_path = os.path.join(str(tmp_path), "scenario.json")
+    with open(plan_path, "w") as f:
+        _json.dump(load_scenario("flaky-link").to_dict(), f)
+
+    keys = sorted([generate_key() for _ in range(3)],
+                  key=lambda k: k.pub_hex)
+    peers = [Peer(net_addr=f"10.0.0.{i}:1337", pub_key_hex=k.pub_hex)
+             for i, k in enumerate(keys)]
+
+    class _Inner:
+        def local_addr(self):
+            return peers[1].net_addr
+
+    args = argparse.Namespace(chaos_plan=plan_path, chaos_seed=None)
+    wrapped = _chaos_wrap(_Inner(), args, keys[1], peers)
+    assert isinstance(wrapped, FaultyTransport)
+    assert wrapped.node_id == 1          # canonical id of our key
+    assert wrapped.addr_index == {p.net_addr: i
+                                  for i, p in enumerate(peers)}
+    assert wrapped.injector.seed == load_scenario("flaky-link").seed
+    # --chaos_seed overrides the scenario's seed
+    args2 = argparse.Namespace(chaos_plan=plan_path, chaos_seed=77)
+    assert _chaos_wrap(_Inner(), args2, keys[1], peers).injector.seed == 77
