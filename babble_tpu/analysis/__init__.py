@@ -1,17 +1,29 @@
 """babble-lint: repo-native static analysis (stdlib-only, tier-1).
 
-Rule families (see ISSUE 1 / the rules' module docstrings):
+Rule families (see ISSUE 1/4 / the rules' module docstrings):
 
 - :mod:`.tracer` — JAX tracer safety inside jitted functions
 - :mod:`.races` — asyncio interleaving races across ``await``
+  (interprocedural: helper calls carry their self-write closures)
 - :mod:`.blocking` — blocking calls (time.sleep, socket I/O) in coroutines
 - :mod:`.invariants` — drain-before-validate + falsy-config fallback
+- :mod:`.randomness` — unseeded global RNG in chaos code paths
+- :mod:`.determinism` — project-wide taint from entropy sources to
+  consensus-order sinks (``consensus-nondeterminism``)
+- :mod:`.guards` — lock re-entry through call chains
+  (``held-guard-escape``)
 
-Run as ``python -m babble_tpu.analysis [--format=text|json] [paths]``;
-suppress a finding with ``# babble-lint: disable=<rule-name>`` on the
-flagged line (or the line above).  The full rule set runs over
-``babble_tpu/`` in tier-1 (tests/test_static_analysis.py), so a new
-finding — or a blanket suppression — fails the build.
+The flow-aware rules stand on :mod:`.graph` (module symbol table +
+project call graph), built once per run by the engine and attached to
+every FileContext as ``ctx.project``.
+
+Run as ``python -m babble_tpu.analysis [--json|--format=...] [--cache
+FILE] [paths]``; suppress a finding with ``# babble-lint:
+disable=<rule-name>`` on the flagged line (or the line above).  A
+suppression whose rule no longer fires is itself a finding
+(``stale-suppression``).  The full rule set runs over ``babble_tpu/``
+in tier-1 (tests/test_static_analysis.py), so a new finding — or a
+blanket/stale suppression — fails the build.
 
 Adding a rule: subclass :class:`~.engine.Rule`, implement
 ``check(ctx)``, append an instance to :data:`ALL_RULES`.  Keep rules
@@ -19,15 +31,21 @@ stdlib-only — this package must import in environments without jax.
 """
 
 from .engine import (
+    ANALYSIS_VERSION,
     BAD_SUPPRESSION,
     PARSE_ERROR,
+    STALE_SUPPRESSION,
     FileContext,
     Finding,
     Rule,
     check_file,
     run_paths,
 )
+from .cache import run_paths_cached
+from .graph import ProjectContext
 from .blocking import AsyncioBlockingCallRule
+from .determinism import ConsensusNondeterminismRule
+from .guards import HeldGuardEscapeRule
 from .invariants import DrainBeforeValidateRule, FalsyOrFallbackRule
 from .races import AwaitStateRaceRule
 from .randomness import ChaosUnseededRandomRule
@@ -44,27 +62,36 @@ ALL_RULES = [
     AwaitStateRaceRule(),
     AsyncioBlockingCallRule(),
     ChaosUnseededRandomRule(),
+    ConsensusNondeterminismRule(),
+    HeldGuardEscapeRule(),
     DrainBeforeValidateRule(),
     FalsyOrFallbackRule(),
 ]
 
-RULE_NAMES = {r.name for r in ALL_RULES} | {BAD_SUPPRESSION, PARSE_ERROR}
+RULE_NAMES = ({r.name for r in ALL_RULES}
+              | {BAD_SUPPRESSION, PARSE_ERROR, STALE_SUPPRESSION})
 
 __all__ = [
     "ALL_RULES",
     "RULE_NAMES",
+    "ANALYSIS_VERSION",
     "BAD_SUPPRESSION",
     "PARSE_ERROR",
+    "STALE_SUPPRESSION",
     "FileContext",
     "Finding",
+    "ProjectContext",
     "Rule",
     "check_file",
     "run_paths",
+    "run_paths_cached",
     "AsyncioBlockingCallRule",
     "AwaitStateRaceRule",
     "ChaosUnseededRandomRule",
+    "ConsensusNondeterminismRule",
     "DrainBeforeValidateRule",
     "FalsyOrFallbackRule",
+    "HeldGuardEscapeRule",
     "JitHostSyncRule",
     "JitTracedBranchRule",
     "JitUnhashableStaticRule",

@@ -1,10 +1,21 @@
-"""babble-lint CLI: ``python -m babble_tpu.analysis [paths...]``.
+"""babble-lint CLI: ``python -m babble_tpu.analysis [paths...]``
+(also mounted as ``python -m babble_tpu.cli lint ...``).
 
 Exit status is the contract CI keys off: 0 = clean, 1 = findings,
-2 = usage error.  ``--format=json`` emits a machine-readable finding
-list (one array, not JSONL) for tooling; text format is
-``path:line:col: rule: message`` — the same shape compilers use, so
-editors and CI annotators parse it for free.
+2 = usage error.  Output formats:
+
+- text (default): ``path:line:col: rule: message`` — the shape
+  compilers use, so editors and CI annotators parse it for free;
+- ``--json``: one finding per line (JSONL) with keys
+  ``rule/path/line/col/message/suppressed`` — suppressed findings ARE
+  emitted (that is the point of the flag: tooling audits what is
+  waived), but only live findings drive the exit status;
+- ``--format=json``: legacy single-array form (live findings only).
+
+``--cache FILE`` keys the whole project-wide result on every file's
+(mtime, size) plus the rule set — an untouched tree replays findings
+without parsing anything (see cache.py for why per-file caching would
+be unsound under cross-module analysis).
 """
 
 from __future__ import annotations
@@ -16,6 +27,7 @@ import sys
 from typing import List, Optional
 
 from . import ALL_RULES
+from .cache import run_paths_cached
 from .engine import run_paths
 
 
@@ -23,8 +35,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m babble_tpu.analysis",
         description="babble-lint: repo-native static analysis for JAX "
-                    "tracer safety, asyncio races and consensus "
-                    "invariants.",
+                    "tracer safety, asyncio races, consensus "
+                    "determinism and invariants.",
     )
     parser.add_argument(
         "paths", nargs="*", default=["babble_tpu"],
@@ -33,6 +45,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--format", choices=("text", "json"), default="text",
         help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit one finding per line as JSON (JSONL), including "
+             "suppressed findings flagged suppressed=true",
+    )
+    parser.add_argument(
+        "--cache", default=None, metavar="FILE",
+        help="whole-run result cache keyed on file mtime+size; an "
+             "untouched tree skips re-parsing entirely",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
@@ -67,13 +89,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"no such file or directory: {missing}", file=sys.stderr)
         return 2
 
-    findings = run_paths(args.paths, rules,
-                         known_rules={r.name for r in ALL_RULES})
-    if args.format == "json":
-        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    from . import RULE_NAMES
+
+    include_suppressed = bool(args.json)
+    if args.cache:
+        findings, _hit = run_paths_cached(
+            args.paths, rules, args.cache, known_rules=RULE_NAMES,
+            include_suppressed=include_suppressed,
+        )
     else:
+        findings = run_paths(args.paths, rules, known_rules=RULE_NAMES,
+                             include_suppressed=include_suppressed)
+
+    live = [f for f in findings if not f.suppressed]
+    if args.json:
         for f in findings:
+            print(json.dumps(f.to_dict(), sort_keys=True))
+    elif args.format == "json":
+        print(json.dumps([f.to_dict() for f in live], indent=2))
+    else:
+        for f in live:
             print(f.format())
-        if findings:
-            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
-    return 1 if findings else 0
+        if live:
+            print(f"\n{len(live)} finding(s)", file=sys.stderr)
+    return 1 if live else 0

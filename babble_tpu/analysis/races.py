@@ -17,6 +17,21 @@ await itself may be inside or outside the lock: holding a lock across
 an await still yields the loop, but other writers of the same attr are
 excluded, which is the invariant that matters.
 
+**Interprocedural (v2)**: a call to ``self.helper(...)`` counts as a
+write of every attribute in the helper's *transitive unlocked
+self-write closure* (graph.ProjectContext.self_write_closure), at the
+call site, under the caller's lock context.  Extracting the mutation
+into a method no longer blinds the rule:
+
+    async def refill(self):
+        self._reset()            # _reset writes self.level -> "write"
+        await self.pump.fill()
+        self._reset()            # second write across the await: race
+
+Helper writes performed under the helper's OWN lock are excluded from
+the closure (they are serialized against other writers), which keeps
+``_process_sync_response``-style lock-everything helpers clean.
+
 Heuristic boundaries: statements are linearized in source order (a
 write in an ``if`` arm counts as "before" a later await even when the
 branch is not taken at runtime), and lock detection is by name.  Both
@@ -27,55 +42,65 @@ suppression; a missed race corrupts a node.
 from __future__ import annotations
 
 import ast
-import re
-from typing import Iterator, List, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from .engine import FileContext, Finding, Rule
+from .graph import names_lock as _names_lock
 
-_LOCKISH = {"lock", "mutex", "sem", "semaphore"}
-# identifier -> words: snake_case segments and camelCase humps, so
-# `core_lock`/`coreLock` match but `block_writer`/`assembler` do not
-# (substring matching would read the `lock` inside `block` as a lock)
-_WORD_RE = re.compile(r"[A-Z]?[a-z0-9]+|[A-Z]+(?![a-z])")
-
-
-def _lockish_name(name: str) -> bool:
-    return any(w.lower() in _LOCKISH for w in _WORD_RE.findall(name))
-
-
-def _names_lock(node: ast.AST) -> bool:
-    """Does this with-context expression look like a lock acquisition?"""
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Attribute) and _lockish_name(sub.attr):
-            return True
-        if isinstance(sub, ast.Name) and _lockish_name(sub.id):
-            return True
-    return False
+# event: (kind, attr, node, locked, via) where via is the helper method
+# name for closure-derived writes ("" for direct writes/awaits)
+_Event = Tuple[str, str, ast.AST, bool, str]
 
 
 class AwaitStateRaceRule(Rule):
     name = "await-state-race"
     description = (
         "coroutine mutates the same self.<attr> both before and after "
-        "an await without holding a lock — another task can interleave "
-        "at the await and observe/clobber the intermediate state"
+        "an await without holding a lock — directly or via called "
+        "helpers — another task can interleave at the await and "
+        "observe/clobber the intermediate state"
     )
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # class membership for self-call resolution: direct methods only
+        # (a nested async def is its own schedule and owns no `self`)
+        method_cls: Dict[int, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.AsyncFunctionDef):
+                        method_cls[id(sub)] = node.name
+        project = getattr(ctx, "project", None)
+        module = (project.path_module.get(ctx.path)
+                  if project is not None else None)
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.AsyncFunctionDef):
-                yield from self._check_coroutine(ctx, node)
+                yield from self._check_coroutine(
+                    ctx, node, project, module, method_cls.get(id(node)))
+
+    def _helper_writes(self, project, module: Optional[str],
+                       cls: Optional[str], method: str) -> frozenset:
+        """Transitive unlocked self-write set of self.<method>()."""
+        if project is None or module is None or cls is None:
+            return frozenset()
+        qual = project.lookup_method((module, cls), method)
+        if qual is None:
+            return frozenset()
+        return frozenset(project.self_write_closure(qual))
 
     def _check_coroutine(
-        self, ctx: FileContext, fn: ast.AsyncFunctionDef
+        self, ctx: FileContext, fn: ast.AsyncFunctionDef,
+        project, module: Optional[str], cls: Optional[str],
     ) -> Iterator[Finding]:
-        # events: ("write", attr, node, locked) | ("await", None, node, _)
-        events: List[Tuple[str, str, ast.AST, bool]] = []
+        self._project = project
+        self._module = module
+        self._cls = cls
+        events: List[_Event] = []
         self._collect(fn.body, locked=False, events=events)
 
-        seen_await_after_write = {}  # attr -> first unlocked write node
-        pending: dict = {}
-        for kind, attr, node, locked in events:
+        seen_await_after_write: Dict[str, ast.AST] = {}
+        pending: Dict[str, ast.AST] = {}
+        for kind, attr, node, locked, via in events:
             if kind == "await":
                 for a, n in pending.items():
                     seen_await_after_write.setdefault(a, n)
@@ -84,11 +109,13 @@ class AwaitStateRaceRule(Rule):
             if locked:
                 continue
             if attr in seen_await_after_write:
+                how = (f" (write via call to `self.{via}()`)" if via
+                       else "")
                 yield self.finding(
                     ctx, node,
                     f"self.{attr} is written both before (line "
                     f"{seen_await_after_write[attr].lineno}) and after an "
-                    f"await in `{fn.name}` without a lock — an "
+                    f"await in `{fn.name}` without a lock{how} — an "
                     "interleaving task sees the intermediate state",
                 )
                 # report once per attr per coroutine
@@ -97,7 +124,7 @@ class AwaitStateRaceRule(Rule):
             pending.setdefault(attr, node)
 
     def _collect(self, body: List[ast.stmt], locked: bool,
-                 events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+                 events: List[_Event]) -> None:
         for stmt in body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
@@ -105,35 +132,59 @@ class AwaitStateRaceRule(Rule):
             self._collect_stmt(stmt, locked, events)
 
     def _awaits_in(self, expr: ast.AST, locked: bool,
-                   events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+                   events: List[_Event]) -> None:
         for node in ast.walk(expr):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)):
                 continue
             if isinstance(node, ast.Await):
-                events.append(("await", "", node, locked))
+                events.append(("await", "", node, locked, ""))
+
+    def _self_calls_in(self, expr: ast.AST, locked: bool,
+                       events: List[_Event]) -> None:
+        """Closure-derived writes: `self.m(...)` writes everything m
+        (transitively) writes on self outside a lock."""
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"):
+                for attr in sorted(self._helper_writes(
+                        self._project, self._module, self._cls,
+                        node.func.attr)):
+                    events.append(
+                        ("write", attr, node, locked, node.func.attr))
+            stack.extend(ast.iter_child_nodes(node))
 
     def _collect_stmt(self, stmt: ast.stmt, locked: bool,
-                      events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+                      events: List[_Event]) -> None:
         if isinstance(stmt, (ast.With, ast.AsyncWith)):
             for item in stmt.items:
                 self._awaits_in(item.context_expr, locked, events)
+                self._self_calls_in(item.context_expr, locked, events)
             if isinstance(stmt, ast.AsyncWith):
                 # `async with x:` awaits __aenter__ even without an
                 # explicit Await node in the source
-                events.append(("await", "", stmt, locked))
+                events.append(("await", "", stmt, locked, ""))
             inner_locked = locked or any(
                 _names_lock(item.context_expr) for item in stmt.items
             )
             self._collect(stmt.body, inner_locked, events)
         elif isinstance(stmt, (ast.If, ast.While)):
             self._awaits_in(stmt.test, locked, events)
+            self._self_calls_in(stmt.test, locked, events)
             self._collect(stmt.body, locked, events)
             self._collect(stmt.orelse, locked, events)
         elif isinstance(stmt, (ast.For, ast.AsyncFor)):
             self._awaits_in(stmt.iter, locked, events)
+            self._self_calls_in(stmt.iter, locked, events)
             if isinstance(stmt, ast.AsyncFor):
-                events.append(("await", "", stmt, locked))
+                events.append(("await", "", stmt, locked, ""))
             self._collect(stmt.body, locked, events)
             self._collect(stmt.orelse, locked, events)
         elif isinstance(stmt, ast.Try):
@@ -145,6 +196,7 @@ class AwaitStateRaceRule(Rule):
         else:
             # simple statement: awaits evaluate before the binding lands
             self._awaits_in(stmt, locked, events)
+            self._self_calls_in(stmt, locked, events)
             if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
                 targets = (stmt.targets if isinstance(stmt, ast.Assign)
                            else [stmt.target])
@@ -152,7 +204,7 @@ class AwaitStateRaceRule(Rule):
                     self._collect_write(t, stmt, locked, events)
 
     def _collect_write(self, target: ast.AST, stmt: ast.stmt, locked: bool,
-                       events: List[Tuple[str, str, ast.AST, bool]]) -> None:
+                       events: List[_Event]) -> None:
         if isinstance(target, (ast.Tuple, ast.List)):
             for elt in target.elts:
                 self._collect_write(elt, stmt, locked, events)
@@ -161,4 +213,4 @@ class AwaitStateRaceRule(Rule):
         elif (isinstance(target, ast.Attribute)
                 and isinstance(target.value, ast.Name)
                 and target.value.id == "self"):
-            events.append(("write", target.attr, stmt, locked))
+            events.append(("write", target.attr, stmt, locked, ""))

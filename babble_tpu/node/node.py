@@ -85,6 +85,12 @@ class Node:
         )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
+        # heartbeat pacing draws from a per-identity seeded stream, not
+        # the process-global RNG (found by the consensus-nondeterminism
+        # taint pass): the jitter exists to desynchronize heartbeats
+        # ACROSS nodes, which distinct ids provide, and a seeded stream
+        # makes live chaos pacing replayable per identity
+        self._pacing_rng = random.Random(f"heartbeat:{own_id}")
         self.transaction_pool: List[bytes] = []
 
         self._shutdown = asyncio.Event()
@@ -701,9 +707,10 @@ class Node:
 
     def _random_timeout(self) -> float:
         """Randomized heartbeat pacing (reference node.go:345-351:
-        uniform in [heartbeat, 2*heartbeat))."""
+        uniform in [heartbeat, 2*heartbeat)), drawn from the node's
+        seeded per-identity stream."""
         hb = self.conf.heartbeat
-        return hb + random.random() * hb
+        return hb + self._pacing_rng.random() * hb
 
     # ------------------------------------------------------------------
     # stats (reference node.go:285-343)

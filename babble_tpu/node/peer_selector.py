@@ -20,14 +20,25 @@ class PeerSelector:
 
 
 class RandomPeerSelector(PeerSelector):
-    """Uniform choice excluding self and the last-gossiped peer."""
+    """Uniform choice excluding self and the last-gossiped peer.
+
+    The default RNG is seeded from the node's own address, NOT OS
+    entropy (found by the consensus-nondeterminism taint pass): peer
+    choice shapes the DAG, and an unseeded stream here was the last
+    per-node decision the chaos plane could not replay from identity +
+    seed alone.  Distinct nodes still draw distinct streams (different
+    addresses), which is all the jitter was ever for; callers that
+    genuinely want shared-seed control pass ``rng`` explicitly."""
 
     def __init__(self, peers: List[Peer], local_addr: str,
                  rng: Optional[random.Random] = None):
         _, self._peers = exclude_peer(peers, local_addr)
         self.local_addr = local_addr
         self.last: Optional[str] = None
-        self._rng = rng or random.Random()
+        # string seeding is content-based (not hash()-randomized), so
+        # the stream is stable across processes and PYTHONHASHSEED
+        self._rng = rng if rng is not None else random.Random(
+            f"peer-selector:{local_addr}")
 
     def peers(self) -> List[Peer]:
         return list(self._peers)

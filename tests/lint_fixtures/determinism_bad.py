@@ -1,0 +1,40 @@
+"""Fixture: consensus-nondeterminism — entropy flowing into the commit
+path, directly and through helper calls (the v1 per-function rules were
+blind to every case here except a source in the sink's own body)."""
+
+import os
+import random
+import time
+
+
+def consensus_sort(events, prn_for_round):
+    # the sink itself: anything nondet inside or feeding callers of
+    # this function diverges honest nodes
+    return sorted(events)
+
+
+def jitter_ns():
+    # source in a NON-sink helper: no finding here — it is reported at
+    # the call that carries the taint into the commit path
+    return time.time_ns()
+
+
+def commit_batch(events):
+    skew = jitter_ns()  # MARK: consensus-nondeterminism
+    return consensus_sort([e + skew for e in events], None)
+
+
+def order_from_set(events):
+    ready = set(events)
+    ordered = [e for e in ready]  # MARK: consensus-nondeterminism
+    return consensus_sort(ordered, None)
+
+
+def salted_fingerprint(tracker):
+    salt = os.environ.get("BABBLE_SALT", "")  # MARK: consensus-nondeterminism
+    return (salt, tracker.schedule_fingerprint())
+
+
+def shuffled_commit(events):
+    random.shuffle(events)  # MARK: consensus-nondeterminism
+    return consensus_sort(events, None)

@@ -1,0 +1,44 @@
+"""Fixture: the sanctioned determinism idioms — every shape here is the
+clean twin of a determinism_bad.py finding and must stay unflagged."""
+
+import random
+import time
+
+
+def consensus_sort(events, prn_for_round):
+    return sorted(events)
+
+
+class Core:
+    def __init__(self, seed):
+        # a bare REFERENCE to the wall clock stored into the hook is
+        # not a read; the chaos runner swaps in a logical clock here
+        self.now_ns = time.time_ns
+        # seeded stream: a pure function of the seed
+        self.rng = random.Random(seed)
+
+    def commit(self, events):
+        ts = self.now_ns()  # through the hook: deterministic per run
+        return consensus_sort([(ts, e) for e in events], None)
+
+    def pick(self, events):
+        return self.rng.choice(events)
+
+
+def order_sorted(events):
+    ready = set(events)
+    # sorted(...) fixes the iteration order before it can leak
+    return consensus_sort(sorted(ready), None)
+
+
+def count_from_set(events):
+    ready = set(events)
+    n = 0
+    for _ in ready:  # order-insensitive consumption: counting
+        n += 1
+    return n
+
+
+def wall_elapsed(t0):
+    # wall clock in a function that never reaches a sink: out of scope
+    return time.time() - t0

@@ -1,0 +1,28 @@
+"""Fixture: held-guard-escape — re-acquiring a held asyncio lock
+through a call chain (asyncio locks are not reentrant: the task
+deadlocks on itself with no traceback)."""
+
+import asyncio
+
+
+class Engine:
+    def __init__(self):
+        self.core_lock = asyncio.Lock()
+        self.jobs = []
+
+    async def _flush(self):
+        async with self.core_lock:
+            self.jobs = []
+
+    async def _indirect(self):
+        # no guard of its own, but its callee re-enters
+        await self._flush()
+
+    async def submit(self, job):
+        async with self.core_lock:
+            self.jobs.append(job)
+            await self._flush()  # MARK: held-guard-escape
+
+    async def submit_indirect(self, job):
+        async with self.core_lock:
+            await self._indirect()  # MARK: held-guard-escape

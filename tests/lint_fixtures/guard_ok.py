@@ -1,0 +1,34 @@
+"""Fixture: guard discipline done right — the already-locked-helper
+convention (`_run_consensus_locked` in node/node.py) and distinct
+guards must stay clean."""
+
+import asyncio
+
+
+class Engine:
+    def __init__(self):
+        self.core_lock = asyncio.Lock()
+        self.stats_lock = asyncio.Lock()
+        self.jobs = []
+        self.stats = 0
+
+    async def _flush_locked(self):
+        # already-locked form: the caller holds the guard, this method
+        # never acquires it
+        self.jobs = []
+
+    async def _count(self):
+        async with self.stats_lock:
+            self.stats += 1
+
+    async def submit(self, job):
+        async with self.core_lock:
+            self.jobs.append(job)
+            await self._flush_locked()
+            await self._count()  # a DIFFERENT guard: nested, not re-entered
+
+    async def flush(self):
+        # acquiring with nothing held is the normal case
+        await self._count()
+        async with self.core_lock:
+            self.jobs = []

@@ -1,0 +1,38 @@
+"""Fixture: INTERPROCEDURAL await-state-race — the mutations hide in
+helper methods, the shape that blinded the v1 per-function rule
+("extract the write into a method and the rule goes quiet")."""
+
+import asyncio
+
+
+class Refiller:
+    def __init__(self):
+        self.level = 0
+        self.state_lock = asyncio.Lock()
+
+    def _reset(self):
+        self.level = 0
+
+    def _bump(self):
+        self.level += 1
+
+    def _bump_indirect(self):
+        # two hops deep: the closure is transitive
+        self._bump()
+
+    async def refill(self):
+        self._reset()
+        await asyncio.sleep(0)  # another task may run here
+        self._bump()  # MARK: await-state-race
+
+    async def refill_deep(self):
+        self._reset()
+        await asyncio.sleep(0)
+        self._bump_indirect()  # MARK: await-state-race
+
+    async def refill_locked(self, items):
+        # clean: both helper calls run under the lock
+        async with self.state_lock:
+            self._reset()
+            await asyncio.sleep(0)
+            self._bump()

@@ -1,0 +1,33 @@
+"""Fixture: helper-call shapes across an await that must stay clean."""
+
+import asyncio
+
+
+class Tracker:
+    def __init__(self):
+        self.count = 0
+        self.done = False
+        self.sync_lock = asyncio.Lock()
+
+    async def _apply(self):
+        # the helper serializes its own write: excluded from the
+        # caller-visible write closure
+        async with self.sync_lock:
+            self.count += 1
+
+    def _start(self):
+        self.count = 0
+
+    def _finish(self):
+        self.done = True
+
+    async def tick(self):
+        await self._apply()
+        await asyncio.sleep(0)
+        await self._apply()
+
+    async def step(self):
+        # different attributes on the two sides of the await
+        self._start()
+        await asyncio.sleep(0)
+        self._finish()

@@ -1,0 +1,14 @@
+"""Fixture: suppressions that outlived their reason — the named rule no
+longer fires on the targeted line, so the waiver itself is a finding."""
+
+
+def tidy(cfg):
+    # the fallback was fixed to an is-None sentinel but the waiver stayed
+    # babble-lint: disable=falsy-or-fallback  # MARK: stale-suppression
+    v = cfg.get("size", None)
+    return 256 if v is None else v
+
+
+def busy(x):
+    y = x + 1  # babble-lint: disable=await-state-race  # MARK: stale-suppression
+    return y

@@ -1,0 +1,6 @@
+"""Fixture: a LIVE suppression — the named rule still fires on its
+line, so the waiver is earning its keep and must not read as stale."""
+
+
+def lookup(cfg, default):
+    return cfg.get("mode", default) or default  # babble-lint: disable=falsy-or-fallback

@@ -1,0 +1,15 @@
+"""Fixture half B (cross-module taint): imports the entropy helper from
+xmod_entropy and feeds it to the commit path.  Only a PROJECT-wide run
+over both files can see the flow — per-file linting of either half is
+clean, which is exactly the hole babble-lint v2 closes."""
+
+from xmod_entropy import skewed_clock
+
+
+def consensus_sort(events, prn_for_round):
+    return sorted(events)
+
+
+def commit(events):
+    t = skewed_clock()  # MARK: consensus-nondeterminism
+    return consensus_sort([(t, e) for e in events], None)

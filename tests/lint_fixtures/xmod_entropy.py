@@ -1,0 +1,8 @@
+"""Fixture half A (cross-module taint): an entropy helper with no sink
+anywhere in this file — linted alone it is clean."""
+
+import time
+
+
+def skewed_clock():
+    return time.time_ns()

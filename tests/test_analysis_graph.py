@@ -1,0 +1,181 @@
+"""Unit tests for the babble-lint v2 project graph (analysis/graph.py):
+symbol tables, call resolution (imports, self-methods across base
+classes, constructor-typed attributes) and the lock-aware closures the
+interprocedural rules consume.  Stdlib-only, like the package."""
+
+import ast
+import os
+
+from babble_tpu.analysis.graph import (
+    ProjectContext,
+    dotted_name,
+    lockish_name,
+    module_name_for,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _project(**files):
+    """Build a ProjectContext from {filename: source} pairs."""
+    parsed = [(name, ast.parse(src, filename=name))
+              for name, src in files.items()]
+    return ProjectContext(parsed)
+
+
+def test_module_name_for_walks_packages():
+    assert module_name_for(
+        os.path.join(REPO, "babble_tpu", "node", "core.py")
+    ) == "babble_tpu.node.core"
+    assert module_name_for(
+        os.path.join(REPO, "babble_tpu", "__init__.py")
+    ) == "babble_tpu"
+    # a file outside any package is just its stem
+    assert module_name_for("/nonexistent/dir/helper.py") == "helper"
+
+
+def test_lockish_name_is_word_boundary_matched():
+    assert lockish_name("core_lock")
+    assert lockish_name("coreLock")
+    assert lockish_name("mutex")
+    assert not lockish_name("block_writer")
+    assert not lockish_name("unblock")
+
+
+def test_dotted_name():
+    assert dotted_name(ast.parse("a.b.c", mode="eval").body) == "a.b.c"
+    assert dotted_name(ast.parse("x", mode="eval").body) == "x"
+    assert dotted_name(ast.parse("f().g", mode="eval").body) == ""
+
+
+def test_free_function_and_import_resolution():
+    p = _project(**{
+        "util.py": "def helper():\n    return 1\n",
+        "main.py": (
+            "from util import helper\n"
+            "import util as u\n"
+            "def local():\n    return 2\n"
+            "def run():\n"
+            "    helper()\n"
+            "    u.helper()\n"
+            "    local()\n"
+        ),
+    })
+    run = p.functions["main:run"]
+    callees = sorted(c for s in run.calls for c in s.callees)
+    assert callees == ["main:local", "util:helper", "util:helper"]
+
+
+def test_self_method_resolves_through_cross_module_base_class():
+    p = _project(**{
+        "base.py": (
+            "class Base:\n"
+            "    def shared(self):\n        return 1\n"
+        ),
+        "child.py": (
+            "from base import Base\n"
+            "class Child(Base):\n"
+            "    def go(self):\n        return self.shared()\n"
+        ),
+    })
+    go = p.functions["child:Child.go"]
+    (site,) = [s for s in go.calls if s.text == "self.shared"]
+    assert site.via_self
+    assert site.callees == ("base:Base.shared",)
+    assert p.lookup_method(("child", "Child"), "shared") == "base:Base.shared"
+
+
+def test_attr_type_union_resolves_all_candidates():
+    """Conditionally-assigned attrs carry the UNION of candidate
+    classes (the Core.hg shape: fused | fork | wide engine)."""
+    p = _project(**{
+        "engines.py": (
+            "class Fused:\n"
+            "    def order(self):\n        return 'f'\n"
+            "class Wide:\n"
+            "    def order(self):\n        return 'w'\n"
+        ),
+        "core.py": (
+            "from engines import Fused, Wide\n"
+            "class Core:\n"
+            "    def __init__(self, wide):\n"
+            "        if wide:\n"
+            "            self.hg = Wide()\n"
+            "        else:\n"
+            "            self.hg = Fused()\n"
+            "    def run(self):\n"
+            "        return self.hg.order()\n"
+        ),
+    })
+    run = p.functions["core:Core.run"]
+    (site,) = [s for s in run.calls if s.text == "self.hg.order"]
+    assert set(site.callees) == {"engines:Fused.order", "engines:Wide.order"}
+    assert not site.via_self  # different object: not a same-self edge
+
+
+def test_write_closure_is_lock_aware_and_transitive():
+    p = _project(**{
+        "m.py": (
+            "class C:\n"
+            "    def a(self):\n"
+            "        self.x = 1\n"
+            "        self.b()\n"
+            "    def b(self):\n"
+            "        self.y = 2\n"
+            "        with self.state_lock:\n"
+            "            self.z = 3\n"        # locked: excluded
+            "            self.c()\n"          # locked call: no propagation
+            "    def c(self):\n"
+            "        self.w = 4\n"
+        ),
+    })
+    assert p.self_write_closure("m:C.a") == {"x", "y"}
+    assert p.self_write_closure("m:C.b") == {"y"}
+    assert p.self_write_closure("m:C.c") == {"w"}
+
+
+def test_guard_closure_propagates_through_all_self_calls():
+    p = _project(**{
+        "m.py": (
+            "class C:\n"
+            "    async def leaf(self):\n"
+            "        async with self.core_lock:\n"
+            "            pass\n"
+            "    async def mid(self):\n"
+            "        await self.leaf()\n"
+            "    async def top(self):\n"
+            "        await self.mid()\n"
+        ),
+    })
+    assert p.guard_closure("m:C.leaf") == {"core_lock"}
+    assert p.guard_closure("m:C.top") == {"core_lock"}
+
+
+def test_relative_import_resolution_in_real_package():
+    """Sanity over the actual tree: Core.sync's `new_event` call (via
+    `from ..core.event import ... new_event`) resolves cross-module."""
+    files = []
+    for rel in ("babble_tpu/node/core.py", "babble_tpu/core/event.py"):
+        path = os.path.join(REPO, rel)
+        with open(path, encoding="utf-8") as f:
+            files.append((path, ast.parse(f.read(), filename=path)))
+    p = ProjectContext(files)
+    sync = p.functions["babble_tpu.node.core:Core.sync"]
+    callees = {c for s in sync.calls for c in s.callees}
+    assert "babble_tpu.core.event:new_event" in callees
+
+
+def test_recursion_does_not_hang_closures():
+    p = _project(**{
+        "m.py": (
+            "class C:\n"
+            "    def a(self):\n"
+            "        self.x = 1\n"
+            "        self.b()\n"
+            "    def b(self):\n"
+            "        self.y = 2\n"
+            "        self.a()\n"
+        ),
+    })
+    assert p.self_write_closure("m:C.a") == {"x", "y"}
+    assert p.self_write_closure("m:C.b") == {"x", "y"}

@@ -187,6 +187,65 @@ def test_random_peer_selector_excludes_self_and_last():
     assert picks == {"a2"}
 
 
+def test_random_peer_selector_default_stream_is_identity_seeded():
+    """Regression for a consensus-nondeterminism finding (ISSUE 4): the
+    default RNG was OS-entropy seeded, making peer choice — which
+    shapes the DAG — the one per-node decision unreproducible from
+    identity + seed.  Two selectors with the same identity must draw
+    the same stream; an explicit rng still overrides."""
+    import random
+
+    peers = [
+        Peer(net_addr=f"a{i}", pub_key_hex=f"0x{i}") for i in range(5)
+    ]
+    a = RandomPeerSelector(peers, "a0")
+    b = RandomPeerSelector(peers, "a0")
+    assert ([a.next().net_addr for _ in range(30)]
+            == [b.next().net_addr for _ in range(30)])
+    # different identity -> different (but still deterministic) stream
+    c1 = RandomPeerSelector(peers, "a1")
+    c2 = RandomPeerSelector(peers, "a1")
+    assert ([c1.next().net_addr for _ in range(30)]
+            == [c2.next().net_addr for _ in range(30)])
+    # explicit rng wins (the chaos runner's shared-seed control path)
+    d = RandomPeerSelector(peers, "a0", rng=random.Random(7))
+    e = RandomPeerSelector(peers, "a0", rng=random.Random(7))
+    assert d.next().net_addr == e.next().net_addr
+
+
+def test_heartbeat_pacing_is_identity_seeded():
+    """Regression for the second consensus-nondeterminism finding: the
+    heartbeat jitter drew from the process-global RNG.  Same identity
+    -> same pacing sequence (live chaos runs become replayable per
+    node); the desynchronization ACROSS nodes that the jitter exists
+    for comes from distinct ids."""
+
+    async def go():
+        net = InmemNetwork()
+        keys = sorted([generate_key() for _ in range(2)],
+                      key=lambda k: k.pub_hex)
+        ts = [net.transport() for _ in keys]
+        peers = [
+            Peer(net_addr=t.local_addr(), pub_key_hex=k.pub_hex)
+            for t, k in zip(ts, keys)
+        ]
+        n0 = Node(Config.test_config(), keys[0], peers, ts[0],
+                  InmemAppProxy())
+        n0b = Node(Config.test_config(), keys[0], peers,
+                   net.transport(), InmemAppProxy())
+        n1 = Node(Config.test_config(), keys[1], peers, ts[1],
+                  InmemAppProxy())
+        seq0 = [n0._random_timeout() for _ in range(10)]
+        seq0b = [n0b._random_timeout() for _ in range(10)]
+        seq1 = [n1._random_timeout() for _ in range(10)]
+        assert seq0 == seq0b
+        assert seq0 != seq1
+        for n in (n0, n0b, n1):
+            await n.shutdown()
+
+    asyncio.run(go())
+
+
 def test_service_debug_endpoints():
     """The pprof analogue on the service listener (reference piggy-backs Go
     pprof on /debug, cmd/main.go:26): stack dump, cProfile window, and the

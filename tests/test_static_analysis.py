@@ -59,12 +59,14 @@ def test_rule_catalog_well_formed():
         assert r.name and r.name == r.name.lower(), r.name
         assert " " not in r.name, f"rule name {r.name!r} is not a slug"
         assert r.description, f"rule {r.name} has no description"
-    # the ISSUE-1 rule families plus the ISSUE-2 blocking-call rule
-    # and the ISSUE-3 chaos-reproducibility rule
+    # the ISSUE-1 rule families, the ISSUE-2 blocking-call rule, the
+    # ISSUE-3 chaos-reproducibility rule, and the ISSUE-4 project-wide
+    # flow-aware rules
     assert {"jit-traced-branch", "jit-host-sync", "jit-unhashable-static",
             "await-state-race", "asyncio-blocking-call",
             "drain-before-validate", "falsy-or-fallback",
-            "chaos-unseeded-random"} <= set(names)
+            "chaos-unseeded-random", "consensus-nondeterminism",
+            "held-guard-escape"} <= set(names)
 
 
 def test_every_suppression_in_tree_names_a_rule():
@@ -80,7 +82,7 @@ def test_every_suppression_in_tree_names_a_rule():
     for path in iter_python_files([PKG]):
         with open(path, encoding="utf-8") as f:
             source = f.read()
-        _, bad = parse_suppressions(source, path, RULE_NAMES)
+        _, bad, _entries = parse_suppressions(source, path, RULE_NAMES)
         assert bad == [], "\n".join(b.format() for b in bad)
 
 
@@ -172,6 +174,137 @@ def test_chaos_randomness_rule_is_path_scoped():
     assert out_of_scope == []
 
 
+# ----------------------------------------------------------------------
+# ISSUE-4 project-wide rules vs fixtures
+
+
+def test_determinism_fixture_findings():
+    """Taint from entropy sources into the commit path: frontier helper
+    calls, unordered set iteration, env reads and global RNG all report
+    in sink-reaching functions; the clean twins stay clean."""
+    path = _fixture("determinism_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "consensus-nondeterminism") == (
+        _marked_lines(path, "consensus-nondeterminism")
+    ), [f.format() for f in findings]
+    assert len(findings) == 4, [f.format() for f in findings]
+
+    ok = check_file(_fixture("determinism_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_determinism_cross_module_taint():
+    """The tentpole property: a wall-clock helper in module A feeding
+    consensus_sort in module B is visible ONLY to the project-wide pass
+    — either file alone is clean."""
+    a = _fixture("xmod_entropy.py")
+    b = _fixture("xmod_commit.py")
+    findings = run_paths([a, b], ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "consensus-nondeterminism") == (
+        _marked_lines(b, "consensus-nondeterminism")
+    ), [f.format() for f in findings]
+    assert all(f.path == b for f in findings)
+    # per-file runs cannot see the flow
+    assert check_file(a, ALL_RULES, known_rules=RULE_NAMES) == []
+    assert check_file(b, ALL_RULES, known_rules=RULE_NAMES) == []
+
+
+def test_interprocedural_race_fixture_findings():
+    """Helper-call writes count at the awaiting caller's site — the
+    "extract the mutation into a method" hole is closed; lock-guarded
+    helpers and disjoint attrs stay clean."""
+    path = _fixture("interproc_race_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "await-state-race") == _marked_lines(
+        path, "await-state-race"
+    ), [f.format() for f in findings]
+    assert len(findings) == 2, [f.format() for f in findings]
+
+    ok = check_file(_fixture("interproc_race_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_guard_fixture_findings():
+    """Re-acquiring a held lock through a call chain (direct and one
+    hop deep) is flagged; the already-locked-helper convention and
+    distinct guards stay clean."""
+    path = _fixture("guard_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "held-guard-escape") == _marked_lines(
+        path, "held-guard-escape"
+    ), [f.format() for f in findings]
+    assert len(findings) == 2, [f.format() for f in findings]
+
+    ok = check_file(_fixture("guard_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_stale_suppression_fixture_findings():
+    """A suppression whose rule no longer fires on its line is itself a
+    finding, anchored at the comment; a live suppression is not."""
+    path = _fixture("stale_suppression_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "stale-suppression") == _marked_lines(
+        path, "stale-suppression"
+    ), [f.format() for f in findings]
+    assert len(findings) == 2, [f.format() for f in findings]
+
+    ok = check_file(_fixture("stale_suppression_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_deep_taint_chain_reports_instead_of_crashing(tmp_path):
+    """Regression: the witness-chain walker used to fall off a hop
+    limit and fabricate a node without a lineno, crashing the whole
+    run — a deep helper chain must still yield a normal finding with a
+    truncated chain in the message."""
+    hops = "\n".join(
+        f"def h{i}():\n    return h{i + 1}()\n" for i in range(9)
+    )
+    src = (
+        "import time\n\n"
+        f"{hops}\n"
+        "def h9():\n    return time.time()\n\n"
+        "def consensus_sort(events, prn):\n    return sorted(events)\n\n"
+        "def commit(events):\n"
+        "    t = h0()\n"
+        "    return consensus_sort([(t, e) for e in events], None)\n"
+    )
+    path = tmp_path / "deep_chain.py"
+    path.write_text(src, encoding="utf-8")
+    findings = check_file(str(path), ALL_RULES, known_rules=RULE_NAMES)
+    assert [f.rule for f in findings] == ["consensus-nondeterminism"]
+    assert "..." in findings[0].message
+
+
+def test_stale_check_respects_rule_subset():
+    """Running a rule SUBSET must not misreport suppressions for
+    unexecuted rules as stale — staleness is only decidable for rules
+    that actually ran."""
+    from babble_tpu.analysis import AwaitStateRaceRule
+
+    path = _fixture("stale_suppression_ok.py")
+    # the file's suppression names falsy-or-fallback; with only the
+    # race rule running, no verdict on it is possible
+    findings = check_file(path, [AwaitStateRaceRule()],
+                          known_rules=RULE_NAMES)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_suppressed_findings_are_retained_when_asked():
+    """include_suppressed keeps waived findings, flagged, so tooling
+    can audit the waiver inventory."""
+    path = _fixture("stale_suppression_ok.py")
+    all_f = check_file(path, ALL_RULES, known_rules=RULE_NAMES,
+                       include_suppressed=True)
+    assert [f.rule for f in all_f] == ["falsy-or-fallback"]
+    assert all_f[0].suppressed is True
+
+
 def test_named_suppression_is_honored():
     findings = check_file(_fixture("suppressed_ok.py"), ALL_RULES,
                           known_rules=RULE_NAMES)
@@ -209,7 +342,9 @@ def test_cli_exits_nonzero_with_locations_on_fixtures():
     for rule in ("jit-traced-branch", "jit-host-sync",
                  "jit-unhashable-static", "await-state-race",
                  "asyncio-blocking-call", "drain-before-validate",
-                 "falsy-or-fallback", "chaos-unseeded-random"):
+                 "falsy-or-fallback", "chaos-unseeded-random",
+                 "consensus-nondeterminism", "held-guard-escape",
+                 "stale-suppression"):
         assert rule in proc.stdout, (rule, proc.stdout)
     import re
 
@@ -241,6 +376,159 @@ def test_cli_nonexistent_path_is_a_usage_error():
 
 def test_cli_rule_subset_keeps_suppression_vocabulary():
     # running a single rule must not misreport suppressions that name
-    # other (real) rules as unknown
+    # other (real) rules as unknown (nor report them stale: staleness
+    # is only decidable for rules that ran)
     proc = _run_cli("--rules=falsy-or-fallback", "babble_tpu")
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ----------------------------------------------------------------------
+# machine-readable output (--json) + incremental cache (--cache)
+
+
+def test_cli_jsonl_schema_roundtrips():
+    """--json emits one finding per line; every line carries the full
+    schema (rule/path/line/col/message/suppressed) and survives a
+    Finding round-trip.  Suppressed findings ARE in the stream, flagged
+    — the live set must equal the in-process run exactly."""
+    from babble_tpu.analysis import Finding
+
+    proc = _run_cli("--json", FIXTURES)
+    assert proc.returncode == 1
+    rows = [json.loads(line) for line in proc.stdout.splitlines() if line]
+    assert rows, proc.stdout
+    for row in rows:
+        assert set(row) == {"rule", "path", "line", "col", "message",
+                            "suppressed"}, row
+        f = Finding.from_dict(row)
+        assert f.to_dict() == row
+    # stale_suppression_ok.py's waived falsy-or-fallback rides along,
+    # flagged — that is the point of the field
+    assert any(r["suppressed"] for r in rows), proc.stdout
+    live = {(r["path"], r["line"], r["rule"]) for r in rows
+            if not r["suppressed"]}
+    expected = {
+        (f.path, f.line, f.rule)
+        for f in run_paths([FIXTURES], ALL_RULES, known_rules=RULE_NAMES)
+    }
+    assert live == expected
+
+
+def test_cache_hit_skips_analysis_and_edit_invalidates(tmp_path):
+    """The whole-run cache: an untouched tree replays findings without
+    re-running anything; touching one file (mtime) or editing it (new
+    finding) forces a full recompute."""
+    import shutil
+    from unittest import mock
+
+    from babble_tpu.analysis import cache as cache_mod
+    from babble_tpu.analysis import run_paths_cached
+
+    src = tmp_path / "src"
+    src.mkdir()
+    for name in ("determinism_bad.py", "guard_ok.py"):
+        shutil.copy(_fixture(name), src / name)
+    cache_file = str(tmp_path / ".babble_lint_cache")
+
+    cold, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                 known_rules=RULE_NAMES)
+    assert hit is False and len(cold) == 4
+
+    # a hit must not parse or analyze ANYTHING: the real run_paths is
+    # unreachable on the hit path
+    with mock.patch.object(cache_mod, "run_paths",
+                           side_effect=AssertionError("cache missed")):
+        warm, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                     known_rules=RULE_NAMES)
+    assert hit is True
+    assert warm == cold
+
+    # a --json run (include_suppressed=True) shares the same entry:
+    # the store is suppressed-inclusive, the view is filtered on read
+    with mock.patch.object(cache_mod, "run_paths",
+                           side_effect=AssertionError("cache missed")):
+        full, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                     known_rules=RULE_NAMES,
+                                     include_suppressed=True)
+    assert hit is True
+    assert [f for f in full if not f.suppressed] == cold
+
+    # mtime bump alone invalidates (content unread by the key)
+    os.utime(src / "guard_ok.py", ns=(1, 1))
+    again, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                  known_rules=RULE_NAMES)
+    assert hit is False and again == cold
+
+    # a real edit changes the result through the refreshed cache
+    with open(src / "guard_ok.py", "a", encoding="utf-8") as f:
+        f.write("\n\ndef bad(cfg):\n    return cfg.get('k', 5) or 5\n")
+    edited, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                   known_rules=RULE_NAMES)
+    assert hit is False
+    assert "falsy-or-fallback" in {f.rule for f in edited}
+
+
+def test_cached_run_is_fast_enough(tmp_path):
+    """Acceptance criterion: the cached project-wide pass costs <= 25%
+    of the cold pass (in practice it is a stat sweep, ~100x cheaper)."""
+    import time
+
+    from babble_tpu.analysis import run_paths_cached
+
+    cache_file = str(tmp_path / ".babble_lint_cache")
+    t0 = time.perf_counter()
+    cold, hit = run_paths_cached([PKG], ALL_RULES, cache_file,
+                                 known_rules=RULE_NAMES)
+    t_cold = time.perf_counter() - t0
+    assert hit is False
+    # best-of-3 warm pass: the real ratio is ~5%, so 25% leaves a wide
+    # margin, but a single stat sweep can still land on a scheduler
+    # stall under CI contention — take the minimum to measure the
+    # mechanism, not the noise
+    t_warm = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        warm, hit = run_paths_cached([PKG], ALL_RULES, cache_file,
+                                     known_rules=RULE_NAMES)
+        t_warm = min(t_warm, time.perf_counter() - t0)
+        assert hit is True and warm == cold
+    assert t_warm <= 0.25 * t_cold, (t_warm, t_cold)
+
+
+def test_cli_cache_flag(tmp_path):
+    cache_file = str(tmp_path / "lint.cache")
+    p1 = _run_cli("--cache", cache_file, "babble_tpu")
+    assert p1.returncode == 0, p1.stdout + p1.stderr
+    assert os.path.exists(cache_file)
+    p2 = _run_cli("--cache", cache_file, "babble_tpu")
+    assert p2.returncode == 0, p2.stdout + p2.stderr
+
+
+def test_corrupt_cache_is_a_miss_not_a_crash(tmp_path):
+    from babble_tpu.analysis import run_paths_cached
+
+    cache_file = tmp_path / "lint.cache"
+    cache_file.write_text("{not json", encoding="utf-8")
+    findings, hit = run_paths_cached(
+        [_fixture("guard_bad.py")], ALL_RULES, str(cache_file),
+        known_rules=RULE_NAMES)
+    assert hit is False
+    assert {f.rule for f in findings} == {"held-guard-escape"}
+
+
+def test_cli_lint_verb():
+    """`babble-tpu lint ...` forwards to the analysis CLI (same exit
+    codes, same --json stream) so CI has one entrypoint."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "babble_tpu.cli", "lint", "--json",
+         os.path.join("tests", "lint_fixtures", "guard_bad.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rows = [json.loads(line) for line in proc.stdout.splitlines() if line]
+    assert {r["rule"] for r in rows} == {"held-guard-escape"}
+    clean = subprocess.run(
+        [sys.executable, "-m", "babble_tpu.cli", "lint", "babble_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
