@@ -12,6 +12,8 @@ Rule families (see ISSUE 1/4 / the rules' module docstrings):
   consensus-order sinks (``consensus-nondeterminism``)
 - :mod:`.guards` — lock re-entry through call chains
   (``held-guard-escape``)
+- :mod:`.walgossip` — self-event mint paths must pass through
+  ``wal.append`` before gossiping (``wal-before-gossip``)
 
 The flow-aware rules stand on :mod:`.graph` (module symbol table +
 project call graph), built once per run by the engine and attached to
@@ -54,6 +56,7 @@ from .tracer import (
     JitTracedBranchRule,
     JitUnhashableStaticRule,
 )
+from .walgossip import WalBeforeGossipRule
 
 ALL_RULES = [
     JitTracedBranchRule(),
@@ -66,6 +69,7 @@ ALL_RULES = [
     HeldGuardEscapeRule(),
     DrainBeforeValidateRule(),
     FalsyOrFallbackRule(),
+    WalBeforeGossipRule(),
 ]
 
 RULE_NAMES = ({r.name for r in ALL_RULES}
@@ -95,4 +99,5 @@ __all__ = [
     "JitHostSyncRule",
     "JitTracedBranchRule",
     "JitUnhashableStaticRule",
+    "WalBeforeGossipRule",
 ]

@@ -18,9 +18,13 @@ package makes hostile networks *reproducible on purpose* (ISSUE 3):
 - :mod:`.invariants` — :class:`InvariantChecker`: safety (cross-node
   prefix agreement), liveness (commits resume after heal), fork
   detection, fast-forward recovery;
+- :mod:`.disk` — seeded durable-state rot (checkpoint/WAL corruption +
+  truncation) applied at restart time, the "disk faults" tier;
 - :mod:`.scenarios` — canned scenarios (flaky-link, minority-partition,
-  crash-restart-with-fast-forward, fork-attack, slow-peer,
-  stale-replay) behind ``babble-tpu chaos run <name> [--seed N]``.
+  crash-restart, disk-rot, fork-attack, slow-peer, stale-replay)
+  behind ``babble-tpu chaos run <name> [--seed N]``.  Crash/restart
+  scenarios run HONEST: the durability plane (babble_tpu/wal) makes
+  restarts seq-exact, so the old fork-aware workaround is gone.
 
 Reproducibility is enforced mechanically: babble-lint's
 ``chaos-unseeded-random`` rule bans module-level ``random.*`` calls in
@@ -28,12 +32,15 @@ chaos code paths — every draw must come from an injector-held seeded
 ``random.Random``.
 """
 
+from .disk import apply_disk_faults
 from .injector import FAULT_KINDS, FaultInjector, OutboundFaults
 from .invariants import InvariantChecker, InvariantReport, Violation
 from .plan import (
+    DISK_FAULT_KINDS,
     KNOWN_INVARIANTS,
     ByzantineSpec,
     Crash,
+    DiskFaults,
     FaultPlan,
     LinkFaults,
     LinkOverride,
@@ -52,10 +59,12 @@ from .transport import FaultyTransport
 
 __all__ = [
     "CANNED",
+    "DISK_FAULT_KINDS",
     "FAULT_KINDS",
     "KNOWN_INVARIANTS",
     "ByzantineSpec",
     "Crash",
+    "DiskFaults",
     "FaultInjector",
     "FaultPlan",
     "FaultyTransport",
@@ -69,6 +78,7 @@ __all__ = [
     "ScenarioResult",
     "ScenarioRunner",
     "Violation",
+    "apply_disk_faults",
     "canned_names",
     "deterministic_keys",
     "load_scenario",

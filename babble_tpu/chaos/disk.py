@@ -1,0 +1,110 @@
+"""Seeded disk rot: the chaos plane's durable-state faults.
+
+Link faults model the network lying; these model the *disk* lying —
+the classic fsync-adjacent failure modes a restart actually meets
+(cf. Protocol-Aware Recovery for Consensus-Based Storage, FAST'18):
+
+- ``checkpoint_corrupt``  — one byte of the checkpoint's ``meta.msgpack``
+  flipped (bit rot in the snapshot header; the restore must refuse it
+  and the boot must degrade to WAL replay, not crash);
+- ``checkpoint_truncate`` — the checkpoint meta chopped at a seeded
+  offset (a torn checkpoint swap);
+- ``wal_corrupt``         — one byte of the newest WAL segment flipped
+  (recovery must truncate at the damaged record and keep everything
+  before it);
+- ``wal_truncate``        — tail bytes of the newest WAL segment
+  removed (the torn final write of a power cut).
+
+Every byte offset and coin flip comes from the injector's per-node
+seeded disk stream (:meth:`FaultInjector.disk_rng`), and the files
+being damaged are themselves deterministic functions of the scenario
+seed (events carry the logical clock, keys are seed-derived), so a
+disk-rot run replays bit-for-bit like every other chaos scenario.
+
+Shared by the deterministic in-memory runner and the live fleet driver
+(both apply faults at restart time, before the node comes back up).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .injector import FaultInjector
+from .plan import DISK_FAULT_KINDS, DiskFaults
+
+#: checkpoint member the corrupt/truncate kinds target — it is fully
+#: deterministic (msgpack of host state), unlike the npz whose zip
+#: headers embed write timestamps
+_CKPT_META = "meta.msgpack"
+
+
+def _newest_wal_segment(wal_dir: str) -> Optional[str]:
+    try:
+        segs = sorted(
+            f for f in os.listdir(wal_dir)
+            if f.startswith("seg-") and f.endswith(".wal")
+            and os.path.getsize(os.path.join(wal_dir, f)) > 0
+        )
+    except OSError:
+        return None
+    return os.path.join(wal_dir, segs[-1]) if segs else None
+
+
+def _flip_byte(path: str, offset: int, xor: int) -> None:
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ xor]))
+
+
+def _apply(kind: str, rng, ckpt_dir: str, wal_dir: str) -> bool:
+    """Damage the durable state for one fault kind; False when the
+    target file does not exist (nothing to rot — not recorded)."""
+    if kind.startswith("checkpoint"):
+        target = os.path.join(ckpt_dir, _CKPT_META)
+        if not os.path.isfile(target) or os.path.getsize(target) == 0:
+            return False
+        size = os.path.getsize(target)
+        if kind == "checkpoint_corrupt":
+            _flip_byte(target, rng.randrange(size), 1 + rng.randrange(255))
+        else:
+            with open(target, "r+b") as f:
+                f.truncate(rng.randrange(size))
+        return True
+    target = _newest_wal_segment(wal_dir)
+    if target is None:
+        return False
+    size = os.path.getsize(target)
+    if kind == "wal_corrupt":
+        # damage the latter half so recovery demonstrably keeps the
+        # records before the corruption point
+        _flip_byte(target, size // 2 + rng.randrange(size - size // 2),
+                   1 + rng.randrange(255))
+    else:
+        with open(target, "r+b") as f:
+            f.truncate(size - min(size, 1 + rng.randrange(64)))
+    return True
+
+
+def apply_disk_faults(
+    injector: FaultInjector,
+    disk: DiskFaults,
+    node: int,
+    ckpt_dir: str,
+    wal_dir: str,
+) -> List[str]:
+    """Roll the seeded dice for every disk-fault kind (fixed order, so
+    the stream stays reproducible) and damage the node's durable state
+    accordingly.  Fired kinds are recorded in the injector log — they
+    show up in ``fault_counts`` / the schedule fingerprint like any
+    other injected fault."""
+    rng = injector.disk_rng(node)
+    fired: List[str] = []
+    for kind in DISK_FAULT_KINDS:
+        p = getattr(disk, kind)
+        if p and rng.random() < p and _apply(kind, rng, ckpt_dir, wal_dir):
+            injector.record(kind, node, node)
+            fired.append(kind)
+    return fired

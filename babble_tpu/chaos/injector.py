@@ -22,8 +22,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .plan import FaultPlan
 
 #: fault kinds as exposed on babble_chaos_faults_total{kind=...}
+#: (disk kinds are driver-applied at restart; they land in the
+#: injector log / fault_counts and pre-exist as metric series)
 FAULT_KINDS = (
     "drop", "delay", "duplicate", "reorder", "partition", "stale_replay",
+    "checkpoint_corrupt", "checkpoint_truncate", "wal_corrupt",
+    "wal_truncate",
 )
 
 
@@ -49,7 +53,7 @@ class FaultInjector:
         self._clock = clock
         self._tick = 0.0
         self._rngs: Dict[Tuple[int, int], random.Random] = {}
-        self._node_rngs: Dict[int, random.Random] = {}
+        self._node_rngs: Dict[object, random.Random] = {}
         self._link_seq: Dict[Tuple[int, int], int] = {}
         #: decision log — only fired faults are recorded; ``seq`` is the
         #: per-link attempt ordinal, so sorting by (src, dst, seq) gives
@@ -89,6 +93,18 @@ class FaultInjector:
         if rng is None:
             rng = self._node_rngs[node] = random.Random(
                 f"babble-chaos:{self.seed}:node:{node}"
+            )
+        return rng
+
+    def disk_rng(self, node: int) -> random.Random:
+        """Per-node disk-rot stream (chaos/disk.py), separate from the
+        byzantine node stream so adding disk faults to a plan never
+        shifts a stale-replay actor's draws."""
+        key = ("disk", node)
+        rng = self._node_rngs.get(key)
+        if rng is None:
+            rng = self._node_rngs[key] = random.Random(
+                f"babble-chaos:{self.seed}:disk:{node}"
             )
         return rng
 

@@ -148,6 +148,44 @@ class Crash:
             )
 
 
+#: disk-fault kinds, in the order the injector draws them at restart
+DISK_FAULT_KINDS = (
+    "checkpoint_corrupt", "checkpoint_truncate",
+    "wal_corrupt", "wal_truncate",
+)
+
+
+@dataclass(frozen=True)
+class DiskFaults:
+    """Durable-state rot applied to a node's checkpoint/WAL files at
+    *restart* time (a crash is when fsync lies surface): each field is
+    the probability that kind fires on a given restart, drawn from the
+    injector's per-node seeded disk stream.  Corrupt = flip one byte at
+    a seeded offset; truncate = chop a seeded number of tail bytes.
+    The restarted node must recover through the durability ladder
+    (checkpoint -> WAL replay truncated at the damage -> seq probe ->
+    gossip/fast-forward) without ever violating prefix agreement."""
+
+    checkpoint_corrupt: float = 0.0
+    checkpoint_truncate: float = 0.0
+    wal_corrupt: float = 0.0
+    wal_truncate: float = 0.0
+
+    def __post_init__(self):
+        for kind in DISK_FAULT_KINDS:
+            _prob(getattr(self, kind), kind)
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in DISK_FAULT_KINDS}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DiskFaults":
+        extra = set(d) - set(DISK_FAULT_KINDS)
+        if extra:
+            raise ValueError(f"unknown disk fault keys: {sorted(extra)}")
+        return cls(**d)
+
+
 @dataclass(frozen=True)
 class ByzantineSpec:
     """One byzantine actor.  ``fork`` mints an equivocating event at
@@ -177,6 +215,8 @@ class FaultPlan:
     partitions: List[Partition] = field(default_factory=list)
     crashes: List[Crash] = field(default_factory=list)
     byzantine: Optional[ByzantineSpec] = None
+    #: durable-state rot applied at restart time (None = disks behave)
+    disk: Optional[DiskFaults] = None
 
     def link(self, src: int, dst: int) -> LinkFaults:
         """Resolved faults for the directed link src -> dst (last
@@ -231,11 +271,14 @@ class FaultPlan:
             b = self.byzantine
             out["byzantine"] = {"node": b.node, "mode": b.mode,
                                 "at": b.at, "prob": b.prob}
+        if self.disk is not None:
+            out["disk"] = self.disk.to_dict()
         return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultPlan":
-        known = {"default", "overrides", "partitions", "crashes", "byzantine"}
+        known = {"default", "overrides", "partitions", "crashes",
+                 "byzantine", "disk"}
         extra = set(d) - known
         if extra:
             raise ValueError(f"unknown fault plan keys: {sorted(extra)}")
@@ -247,12 +290,14 @@ class FaultPlan:
                 faults=LinkFaults.from_dict(ov), src=src, dst=dst,
             ))
         byz = d.get("byzantine")
+        disk = d.get("disk")
         return cls(
             default=LinkFaults.from_dict(d.get("default", {})),
             overrides=overrides,
             partitions=[Partition(**p) for p in d.get("partitions", [])],
             crashes=[Crash(**c) for c in d.get("crashes", [])],
             byzantine=ByzantineSpec(**byz) if byz else None,
+            disk=DiskFaults.from_dict(disk) if disk else None,
         )
 
 
@@ -283,6 +328,11 @@ class Scenario:
     #: fault-free all-to-all gossip rounds appended after the plan runs
     #: (the "network eventually behaves" phase convergence checks need)
     settle_rounds: int = 6
+    #: in-memory runner: save a durable checkpoint for every live node
+    #: each N ticks (0 = WAL-only durability).  Only meaningful when the
+    #: plan crashes nodes — a stale checkpoint plus the WAL tail is
+    #: exactly the state a restart must recover from.
+    checkpoint_every: int = 0
     #: live mode: wall seconds per tick
     tick_seconds: float = 0.05
 
@@ -311,6 +361,7 @@ class Scenario:
             "invariants": list(self.invariants),
             "liveness_bound": self.liveness_bound,
             "settle_rounds": self.settle_rounds,
+            "checkpoint_every": self.checkpoint_every,
             "tick_seconds": self.tick_seconds,
             "plan": self.plan.to_dict(),
         }
@@ -322,7 +373,8 @@ class Scenario:
         known = {
             "name", "nodes", "steps", "seed", "engine", "cache_size",
             "seq_window", "txs", "tx_every", "invariants",
-            "liveness_bound", "settle_rounds", "tick_seconds",
+            "liveness_bound", "settle_rounds", "checkpoint_every",
+            "tick_seconds",
         }
         extra = set(d) - known
         if extra:

@@ -30,7 +30,10 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import os
 import random
+import shutil
+import tempfile
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
@@ -40,6 +43,7 @@ from ..net.peers import Peer
 from ..node.config import Config
 from ..node.node import Node
 from ..proxy.inmem import InmemAppProxy
+from .disk import apply_disk_faults
 from .injector import FaultInjector
 from .invariants import InvariantChecker, InvariantReport
 from .plan import ByzantineSpec, Scenario, crash_schedule
@@ -173,7 +177,28 @@ class ScenarioRunner:
             _Handle(idx=i, addr=addrs[i], key=keys[i]) for i in range(n)
         ]
 
-        def make_conf() -> Config:
+        # Honest crash scenarios run DURABLY: each node writes a real
+        # on-disk WAL (fsync=off — in-process durability, the tier-1
+        # fast path) plus optional periodic checkpoints, a crash drops
+        # the live engine on the floor, and the restart recovers
+        # through the real ladder (checkpoint -> WAL replay -> seq
+        # probe -> gossip/fast-forward).  This is what lets crash
+        # scenarios run honest-mode: recovery is seq-exact, so a
+        # restarted node never re-mints a published index and no peer
+        # ever reads it as an equivocator.  (Byzantine-engine crashes
+        # keep the legacy keep-the-engine model: fork-aware restarts
+        # are exercised by the live tier.)
+        durable = (sc.engine == "fused"
+                   and bool(sc.plan.crashes or sc.plan.disk))
+        durable_root = (
+            tempfile.mkdtemp(prefix="babble-chaos-durable-")
+            if durable else None
+        )
+
+        def ckpt_dir(i: int) -> str:
+            return os.path.join(durable_root, f"node{i}", "ckpt")
+
+        def make_conf(i: int) -> Config:
             conf = Config.test_config(heartbeat=1.0)
             conf.cache_size = sc.cache_size
             conf.seq_window = sc.seq_window
@@ -183,16 +208,21 @@ class ScenarioRunner:
             # runs (a timer task would reintroduce wall-clock
             # nondeterminism) — see _maybe_consensus
             conf.consensus_interval = 1e9
+            if durable:
+                conf.wal_dir = os.path.join(durable_root, f"node{i}", "wal")
+                conf.wal_fsync = "off"
             return conf
 
         def boot(h: _Handle, engine=None) -> None:
             inner = net.transport(h.addr)
             transport = FaultyTransport(inner, injector, h.idx, addr_index)
             h.proxy = InmemAppProxy()
-            h.node = Node(make_conf(), h.key, peers, transport, h.proxy,
-                          engine=engine)
+            h.node = Node(make_conf(h.idx), h.key, peers, transport,
+                          h.proxy, engine=engine)
             h.node.core.now_ns = clock
             if engine is None:
+                # recovery-aware: skipped when WAL replay restored a
+                # head, deferred while the seq probe negotiates
                 h.node.init()
             h.node.run_task(gossip=False)
             h.alive = True
@@ -230,20 +260,53 @@ class ScenarioRunner:
                 for action, node_idx in sched.get(step, ()):
                     h = handles[node_idx]
                     if action == "crash" and h.alive:
-                        h.saved_engine = h.node.core.hg
+                        if durable:
+                            # power-cut semantics: drop the file handles
+                            # with NO clean-shutdown receipt and discard
+                            # the live engine — whatever the WAL (and
+                            # any periodic checkpoint) captured is all
+                            # the restart gets
+                            h.saved_engine = None
+                            h.node.core.wal.abort()
+                        else:
+                            h.saved_engine = h.node.core.hg
                         await h.node.shutdown()
                         h.alive = False
                         injector.record("crash", node_idx, node_idx)
                     elif action == "restart" and not h.alive:
-                        # restart from the engine the node held at crash
-                        # time — the checkpoint-restored-process model.
-                        # If the fleet moved past its window meanwhile,
-                        # its first syncs draw too_late -> fast-forward.
-                        boot(h, engine=h.saved_engine)
+                        if durable:
+                            # the real recovery ladder: seeded disk rot
+                            # first (that is when fsync lies surface),
+                            # then checkpoint -> WAL replay -> probe
+                            if sc.plan.disk is not None:
+                                apply_disk_faults(
+                                    injector, sc.plan.disk, node_idx,
+                                    ckpt_dir(node_idx),
+                                    os.path.join(durable_root,
+                                                 f"node{node_idx}", "wal"),
+                                )
+                            from ..store import load_checkpoint_tolerant
+
+                            engine, _err = load_checkpoint_tolerant(
+                                ckpt_dir(node_idx)
+                            ) if os.path.isdir(ckpt_dir(node_idx)) \
+                                else (None, None)
+                            boot(h, engine=engine)
+                        else:
+                            # byzantine crashes restart from the engine
+                            # held at crash time (the fork-aware
+                            # checkpoint-restored-process model)
+                            boot(h, engine=h.saved_engine)
                         h.engine_at_restart = h.node.core.hg
                         h.restarted = True
                         result.restarted.add(node_idx)
                         injector.record("restart", node_idx, node_idx)
+                if (durable and sc.checkpoint_every > 0
+                        and step % sc.checkpoint_every
+                        == sc.checkpoint_every - 1):
+                    for h in handles:
+                        if h.alive:
+                            await h.node.save_checkpoint(ckpt_dir(h.idx))
                 if heal_tick is not None and step == heal_tick:
                     result.consensus_counts_at_heal = await sample_counts()
                 if (heal_tick is not None
@@ -334,6 +397,8 @@ class ScenarioRunner:
             for h in handles:
                 if h.alive:
                     await h.node.shutdown()
+            if durable_root is not None:
+                shutil.rmtree(durable_root, ignore_errors=True)
 
         result.fault_schedule = injector.schedule_fingerprint()
         counts: Dict[str, int] = {}
@@ -499,17 +564,21 @@ def run_live(
             "--chaos_plan", plan_path, "--chaos_seed", str(scenario.seed),
             "--chaos_epoch", repr(epoch),
         ],
-        # crash/restart in a live fleet needs both: recent checkpoints
-        # (or the restart boots a fresh root) and fork-aware engines (a
-        # restart from a stale checkpoint re-mints already-published
-        # sequence numbers, which only byzantine mode tolerates — see
-        # the ROADMAP crash-recovery-amnesia item)
-        byzantine=(scenario.engine == "byzantine"
-                   or bool(scenario.plan.crashes)),
+        # crash/restart runs HONEST since the durability plane landed:
+        # a killed node replays its per-event WAL on top of the newest
+        # checkpoint and resumes at its true head seq, so no peer ever
+        # reads the restart as an equivocation (the old workaround —
+        # fork-aware engines + tight checkpoints tolerating re-minted
+        # indexes — is gone; see ROADMAP crash-recovery amnesia, fixed)
+        byzantine=(scenario.engine == "byzantine"),
         checkpoints=bool(scenario.plan.crashes),
+        wal=bool(scenario.plan.crashes or scenario.plan.disk),
     )
     duration = scenario.steps * scenario.tick_seconds
     sched = crash_schedule(scenario.plan)
+    # driver-side injector: only its disk stream is consumed, so the
+    # node processes' own (plan, seed) fault streams are untouched
+    disk_injector = FaultInjector(scenario.plan, scenario.seed)
     report: dict = {"name": scenario.name, "seed": scenario.seed,
                     "duration_s": duration}
     runner.start()
@@ -532,6 +601,16 @@ def run_live(
                     log(f"[chaos] tick {tick}: crash node {node_idx}")
                     runner.kill_node(node_idx)
                 else:
+                    if scenario.plan.disk is not None:
+                        d = os.path.join(base_dir, f"node{node_idx}")
+                        fired = apply_disk_faults(
+                            disk_injector, scenario.plan.disk, node_idx,
+                            os.path.join(d, "ckpt"),
+                            os.path.join(d, "wal"),
+                        )
+                        if fired:
+                            log(f"[chaos] tick {tick}: disk rot on node "
+                                f"{node_idx}: {', '.join(fired)}")
                     log(f"[chaos] tick {tick}: restart node {node_idx}")
                     runner.restart_node(node_idx)
             deadline = epoch + (tick + 1) * scenario.tick_seconds

@@ -45,23 +45,48 @@ CANNED: Dict[str, dict] = {
             "partitions": [{"group": [3], "start": 60, "heal": 180}],
         },
     },
-    # a node dies at tick 0 (before its root propagates), the fleet's
-    # rolling windows evict far past it, and the restart can only catch
-    # up through the snapshot RPC.  The crash must predate propagation
-    # because slot-prefix eviction retains every known creator's last
-    # seq_window events — once a creator's events are in the window,
-    # its silence WEDGES eviction at its oldest retained slot and the
-    # window stops moving entirely (chaos surfaced this; recorded as a
-    # ROADMAP open item), so mid-life downtime can never trigger a
-    # fast-forward in the current engine
-    "crash-restart-with-fast-forward": {
-        "name": "crash-restart-with-fast-forward",
+    # a node crashes and restarts HONEST (non-fork-aware), recovering
+    # through the durability ladder: the runner gives every node a real
+    # on-disk WAL, the crash drops the live engine, and the restart
+    # replays the log to resume at its published head seq — no peer
+    # ever reads it as an equivocator (this scenario ran fork-aware
+    # before the WAL landed; see ROADMAP crash-recovery amnesia,
+    # fixed).  The crash predates propagation and the fleet's rolling
+    # windows evict far past the outage, so the rejoin also exercises
+    # the snapshot RPC (fast_forwarded).  Crashing at tick 0 still
+    # matters for eviction: slot-prefix eviction retains every known
+    # creator's last seq_window events, so a MID-life crash would wedge
+    # the window at the silent creator's tail and no fast-forward could
+    # ever trigger (ROADMAP eviction-wedge open item).
+    "crash-restart": {
+        "name": "crash-restart",
         "nodes": 4, "steps": 480, "seed": 13,
         "cache_size": 64, "seq_window": 8,
         "txs": 12, "tx_every": 12, "liveness_bound": 100,
         "invariants": ["prefix_agreement", "liveness", "fast_forwarded"],
         "plan": {
             "crashes": [{"node": 3, "crash": 0, "restart": 340}],
+        },
+    },
+    # durable-state rot: a mid-life crash restarts into a checkpoint
+    # with a flipped byte and a WAL with a torn tail.  The boot must
+    # degrade (refuse the checkpoint, truncate the log at the damage,
+    # defer minting behind the seq probe) and rejoin through gossip
+    # without ever re-minting a published index — prefix agreement
+    # holds across the rot.  cache_size is sized so nothing evicts:
+    # the mid-life crash + eviction wedge interaction is the ROADMAP
+    # eviction open item, not this scenario's subject
+    "disk-rot": {
+        "name": "disk-rot",
+        "nodes": 4, "steps": 360, "seed": 29,
+        "cache_size": 2048,
+        "txs": 12, "tx_every": 10, "liveness_bound": 120,
+        "checkpoint_every": 40,
+        "invariants": ["prefix_agreement", "liveness"],
+        "plan": {
+            "default": {"drop": 0.03},
+            "crashes": [{"node": 2, "crash": 120, "restart": 200}],
+            "disk": {"checkpoint_corrupt": 1.0, "wal_truncate": 1.0},
         },
     },
     # a fork-emitting peer plants equivocating branches at two honest

@@ -85,9 +85,33 @@ async def _run_node(args) -> int:
     engine = None
     ckpt_dir = getattr(args, "checkpoint_dir", "")
     if ckpt_dir and os.path.isdir(ckpt_dir):
-        from .store import load_checkpoint
+        # corruption-tolerant restart: a rotten checkpoint degrades to
+        # a fresh engine + WAL replay + gossip/fast-forward instead of
+        # a dead node (the chaos plane's disk-rot scenario pins this)
+        from .store import load_checkpoint_tolerant
 
-        engine = load_checkpoint(ckpt_dir)
+        engine, ckpt_err = load_checkpoint_tolerant(ckpt_dir)
+        if ckpt_err is not None:
+            if not getattr(args, "wal_dir", ""):
+                # without a WAL there is no mint floor and no seq
+                # probe: booting a fresh root here would re-mint every
+                # published seq and peers would read this identity as
+                # an equivocator (the crash-recovery-amnesia defect) —
+                # refuse instead of silently poisoning the fleet
+                raise SystemExit(
+                    f"checkpoint {ckpt_dir} is unreadable ({ckpt_err}) "
+                    "and no --wal_dir is configured: a fresh boot would "
+                    "re-mint published sequence numbers.  Configure "
+                    "--wal_dir (recovery degrades safely through the "
+                    "WAL + seq probe), restore the checkpoint, or "
+                    "remove the directory to explicitly start over."
+                )
+            print(
+                f"warning: checkpoint {ckpt_dir} unreadable ({ckpt_err}); "
+                "starting fresh and recovering from the WAL",
+                file=sys.stderr,
+            )
+    if engine is not None:
         from .store.checkpoint import engine_mode
 
         mode = engine_mode(engine)
@@ -136,6 +160,8 @@ async def _run_node(args) -> int:
         engine=getattr(args, "engine", "fused"),
         wide_caps=_parse_fork_caps(getattr(args, "wide_caps", ""),
                                    flag="--wide_caps"),
+        wal_dir=getattr(args, "wal_dir", ""),
+        wal_fsync=getattr(args, "wal_fsync", "batch"),
     )
     conf.logger.setLevel(args.log_level.upper())
 
@@ -166,6 +192,9 @@ async def _run_node(args) -> int:
 
     node = Node(conf, key, peers, transport, proxy, engine=engine)
     if engine is None:
+        # Node.init is recovery-aware: it skips the root mint when WAL
+        # replay already restored a head, and defers it while the seq
+        # probe negotiates a skip-ahead with the fleet
         node.init()
     service = Service(args.service_addr, node,
                       allow_remote_debug=args.allow_remote_debug)
@@ -603,6 +632,13 @@ def main(argv=None) -> int:
                     help="resume from + periodically checkpoint to this dir")
     rn.add_argument("--checkpoint_interval", type=float, default=30.0,
                     help="seconds between checkpoints")
+    rn.add_argument("--wal_dir", default="",
+                    help="per-event write-ahead log dir: restart replays "
+                         "the tail on top of the newest checkpoint, so "
+                         "the node resumes at its published head seq")
+    rn.add_argument("--wal_fsync", default="batch",
+                    help="WAL fsync policy: always | batch(n,ms) | off "
+                         "(default batch = 64 appends / 50 ms)")
     rn.add_argument("--chaos_plan", default="",
                     help="scenario/fault-plan JSON: wrap the transport "
                          "in a seeded FaultyTransport (chaos testing)")

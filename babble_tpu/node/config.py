@@ -61,6 +61,15 @@ class Config:
     # re-jits (tens of seconds each) otherwise starve gossip for
     # minutes after startup.
     fork_caps: tuple | None = None
+    # Durability plane (babble_tpu/wal): "" disables the write-ahead
+    # log (the pre-WAL behavior — restarts may re-mint published seqs
+    # unless a fresh checkpoint exists).  With a directory set, every
+    # inserted event is logged (self-events before they're gossipable)
+    # and restart replays the tail on top of the newest checkpoint.
+    wal_dir: str = ""
+    # Fsync policy: "always", "batch(n,ms)" (bare "batch" = 64,50ms),
+    # or "off" (flush only — the tier-1 test fast path).
+    wal_fsync: str = "batch"
     logger: logging.Logger = field(default_factory=_default_logger)
 
     @classmethod

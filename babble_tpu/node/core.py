@@ -16,6 +16,7 @@ from ..consensus.engine import TpuHashgraph
 from ..core.event import Event, WireEvent, new_event
 from ..crypto.keys import KeyPair
 from ..obs import Registry
+from ..wal import WriteAheadLog
 
 
 class Core:
@@ -35,6 +36,7 @@ class Core:
         wide: bool = False,
         wide_caps: Optional[tuple] = None,
         registry: Optional[Registry] = None,
+        wal: Optional[WriteAheadLog] = None,
     ):
         self.id = core_id
         self.key = key
@@ -125,6 +127,33 @@ class Core:
         # (progress), so interleaved healthy syncs cannot wipe it:
         # divergence depth d heals in ~log2(d) failing syncs total.
         self._creator_backoff: Dict[int, int] = {}
+        # Durability plane (wal/): the write-ahead log is replayed on
+        # top of whatever engine we booted with (fresh or checkpoint-
+        # restored), so the node resumes at its true head seq and never
+        # re-mints a sequence number it already published (ROADMAP
+        # crash-recovery amnesia).  _min_next_seq is the mint floor the
+        # recovery ladder established; while the engine's own chain sits
+        # below it, minting is deferred and gossip/fast-forward restore
+        # the published tail first.
+        self.wal = wal
+        self._wal_own_max = -1
+        self._wal_orphans: List[Event] = []
+        self._min_next_seq = 0
+        # Peer-negotiated seq skip-ahead (the WAL-missing fallback): no
+        # durable memory of our own chain exists, so minting waits for a
+        # supermajority of peers (counting ourselves) to answer a sync —
+        # each applied response merges that peer's view of our chain, so
+        # at quorum the engine head IS the max published seq any
+        # supermajority member has seen, and _min_next_seq lands one
+        # past it.
+        self._probing = False
+        self._probe_seen: set = set()
+        # supermajority is 2n//3+1 members counting ourselves, so the
+        # probe needs 2n//3 PEER answers — 0 for a single-participant
+        # fleet, where our own durable state is the only authority
+        self._probe_quorum = 2 * len(participants) // 3
+        if wal is not None:
+            self._recover_from_wal()
         self.head: str = ""
         self.seq: int = -1
         # A resumed engine (store.load_checkpoint) already holds our chain —
@@ -141,6 +170,20 @@ class Core:
                 head_ev = self.hg.dag.events[chain[-1]]
                 self.head = head_ev.hex()
                 self.seq = head_ev.index
+        if wal is not None:
+            # the mint floor: one past the newest self-event the WAL
+            # (records + head receipt) remembers publishing.  A torn
+            # tail may have lost the newest receipt-less records, so a
+            # truncated log ALSO probes — re-minting a seq a minority of
+            # peers already hold would read as an equivocation.
+            self._min_next_seq = max(
+                self._wal_own_max + 1, wal.receipt_seq + 1
+            )
+            # probe whenever recovery cannot vouch for every published
+            # seq: missing log, torn tail, or an unclean shutdown under
+            # a batched fsync policy (a whole record suffix can be lost
+            # at a clean fsync boundary with nothing left to detect)
+            self._probing = self._probe_quorum > 0 and wal.needs_probe
 
         if registry is not None:
             # sampled at scrape time through self.hg so the gauges stay
@@ -182,6 +225,103 @@ class Core:
                     "babble_forked_creators",
                     "creators with a detected live equivocation",
                 ).set_function(lambda: _snap().get("forked_creators", 0))
+
+    # ------------------------------------------------------------------
+    # durability (wal/): recovery, the mint floor, the seq probe
+
+    def _recover_from_wal(self) -> None:
+        """Replay the WAL tail on top of the booted engine (recovery
+        already truncated it at the first torn/corrupt record).  Replay
+        is best-effort per event: a record whose parents predate a
+        restored checkpoint's window simply fails to insert — the fleet
+        re-delivers through gossip/fast-forward — but every surviving
+        SELF record still raises the mint floor, insertable or not,
+        because those seqs were published."""
+        replayed = 0
+        for ev in self.wal.recovered_events:
+            if ev.creator == self.pub_hex:
+                self._wal_own_max = max(self._wal_own_max, ev.index)
+            if ev.hex() in self.hg.dag.slot_of:
+                continue
+            try:
+                self.hg.insert_event(ev)
+                replayed += 1
+            except ValueError:
+                if ev.creator == self.pub_hex:
+                    # a durably-logged SELF event whose parents predate
+                    # the restored window (e.g. the checkpoint rotted
+                    # away): it raised the mint floor above, so it must
+                    # stay retryable — once gossip restores its parents,
+                    # re-inserting the SAME signed event un-wedges
+                    # minting without any equivocation risk.  Dropping
+                    # it here would leave the floor unreachable and the
+                    # node mute forever.
+                    self._wal_orphans.append(ev)
+                continue
+        self.wal.mark_replayed(replayed)
+
+    def _wal_append(self, event: Event) -> None:
+        if self.wal is not None:
+            self.wal.append(event)
+
+    @property
+    def probing(self) -> bool:
+        return self._probing
+
+    @property
+    def min_next_seq(self) -> int:
+        return self._min_next_seq
+
+    def mint_blocked(self) -> bool:
+        """True while creating a self-event could re-mint a published
+        sequence number: either the seq probe is still negotiating, or
+        the engine's view of our own chain sits below the recovery
+        ladder's mint floor (gossip / fast-forward will restore the
+        published tail, at which point minting resumes naturally)."""
+        return self._probing or self.seq + 1 < self._min_next_seq
+
+    def probe_note(self, peer: str) -> bool:
+        """One sync response from ``peer`` was applied while probing.
+        Returns True exactly when this response completed the quorum:
+        the engine head now reflects the max seq a supermajority
+        (counting ourselves) has seen of us, so minting resumes one
+        past it."""
+        if not self._probing:
+            return False
+        self._probe_seen.add(peer)
+        if len(self._probe_seen) < self._probe_quorum:
+            return False
+        self._probing = False
+        self._min_next_seq = max(self._min_next_seq, self.seq + 1)
+        return True
+
+    def _adopt_own_event(self, ev: Event) -> None:
+        """A peer (or snapshot) delivered one of OUR published events
+        that the crash lost: advance head/seq so the next mint extends
+        the true chain instead of re-minting its index."""
+        if ev.creator == self.pub_hex and ev.index > self.seq:
+            self.head = ev.hex()
+            self.seq = ev.index
+
+    def _retry_wal_orphans(self) -> None:
+        """Re-attempt the recovered self events whose first insert
+        failed (parents were outside the restored window).  Called
+        after each sync's peer inserts: once gossip has restored the
+        missing ancestry, the orphan inserts, head/seq adopt it, and
+        the mint floor it pinned becomes reachable again."""
+        if not self._wal_orphans:
+            return
+        rest: List[Event] = []
+        for ev in sorted(self._wal_orphans, key=lambda e: e.index):
+            if ev.hex() in self.hg.dag.slot_of:
+                self._adopt_own_event(ev)
+                continue
+            try:
+                self.hg.insert_event(ev)
+                self._adopt_own_event(ev)
+            except ValueError:
+                rest.append(ev)
+        self._wal_orphans = rest
 
     # ------------------------------------------------------------------
 
@@ -349,13 +489,22 @@ class Core:
             ) from e
 
     def init(self) -> None:
-        """Create + insert the node's root event (reference core.go:79-97)."""
+        """Create + insert the node's root event (reference core.go:79-97).
+        A no-op while the durability ladder blocks minting (seq probe in
+        flight, or the WAL says seq 0 was already published)."""
+        if self.mint_blocked():
+            return
         ev = new_event([], ("", ""), self.key.pub_bytes, 0,
                        timestamp=self.now_ns())
         self.sign_and_insert_self_event(ev)
 
     def sign_and_insert_self_event(self, event: Event) -> None:
         event.sign(self.key)
+        # write-AHEAD: the event hits the log (fsynced per policy)
+        # before the insert that makes it gossipable, so a crash can
+        # never forget a seq any peer might have seen.  An insert
+        # failure leaves an orphan record; replay dedups it.
+        self._wal_append(event)
         self.hg.insert_event(event)
         self.head = event.hex()
         self.seq = event.index
@@ -439,6 +588,8 @@ class Core:
                 cid = self.participants.get(ev.creator)
                 try:
                     self.insert_event(ev)
+                    self._wal_append(ev)
+                    self._adopt_own_event(ev)
                     self._creator_backoff.pop(cid, None)  # progress
                 except ValueError as e:   # includes ForkBudgetError
                     from ..ops.forks import ParentUnknownError
@@ -458,6 +609,9 @@ class Core:
                     continue
             else:
                 self.insert_event(ev)
+                self._wal_append(ev)
+                self._adopt_own_event(ev)
+        self._retry_wal_orphans()
         if self.byzantine and other_head not in self.hg.dag.slot_of:
             # the peer's head itself was skipped (its parents reference
             # events we don't hold yet): keep everything inserted, but
@@ -469,6 +623,12 @@ class Core:
             self.insert_failures += 1
             self.last_insert_error = "peer head not insertable; merge skipped"
             return False
+        if self.mint_blocked():
+            # recovery gate: the peer's events are in, but minting here
+            # could reuse a published index (WAL replay gap, or the seq
+            # probe still negotiating).  Returning False tells the node
+            # the payload never rode a self-event, so it requeues.
+            return False
         ev = new_event(
             payload, (self.head, other_head), self.key.pub_bytes,
             self.seq + 1, timestamp=self.now_ns(),
@@ -476,9 +636,12 @@ class Core:
         self.sign_and_insert_self_event(ev)
         return True
 
-    def add_self_event(self, payload: List[bytes]) -> None:
+    def add_self_event(self, payload: List[bytes]) -> bool:
         """Self-parent-only event carrying pooled txs (used when there is
-        nothing to sync but transactions wait; reference core.go:159-169)."""
+        nothing to sync but transactions wait; reference core.go:159-169).
+        Returns False (payload not minted) while recovery blocks minting."""
+        if self.mint_blocked():
+            return False
         if self.head == "":
             self.init()
         ev = new_event(
@@ -486,6 +649,7 @@ class Core:
             self.seq + 1, timestamp=self.now_ns(),
         )
         self.sign_and_insert_self_event(ev)
+        return True
 
     # ------------------------------------------------------------------
 

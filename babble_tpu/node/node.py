@@ -70,9 +70,21 @@ class Node:
         local_addr = transport.local_addr()
         own_id = participants[key.pub_hex]
 
+        # durability plane: the WAL constructor performs recovery
+        # (scan + truncate-at-first-bad-record); Core replays the
+        # surviving tail on top of `engine` below, so head/seq resume
+        # at the node's true published position
+        wal = None
+        if conf.wal_dir:
+            from ..wal import WriteAheadLog
+
+            wal = WriteAheadLog(
+                conf.wal_dir, fsync=conf.wal_fsync, registry=self.registry
+            )
         self.core = Core(
             own_id, key, participants,
             commit_callback=None, engine=engine,
+            wal=wal,
             e_cap=max(conf.cache_size, 64),
             cache_size=conf.cache_size,
             seq_window=conf.seq_window,
@@ -204,18 +216,33 @@ class Node:
     # ------------------------------------------------------------------
 
     def init(self) -> None:
-        """Create the root event (reference node.go:105-112)."""
-        self.core.init()
+        """Create the root event (reference node.go:105-112).  Skipped
+        when WAL recovery already restored a head, and deferred while
+        the seq probe negotiates (a node whose durable state vanished
+        must not mint seq 0 until a supermajority confirms nobody holds
+        a higher seq under our key)."""
+        if self.core.probing:
+            self.logger.warning(
+                "WAL missing or truncated: deferring first mint until a "
+                "supermajority of peers confirm our published head seq"
+            )
+            return
+        if self.core.head == "":
+            self.core.init()
 
     async def save_checkpoint(self, path: str) -> None:
         """Snapshot consensus state under the core lock (see store.checkpoint
         — persistence the reference's Store seam never implemented).
         Byzantine mode snapshots ForkDag host state (branch columns,
-        seeds, window) — see store.checkpoint._build_fork_meta."""
+        seeds, window) — see store.checkpoint._build_fork_meta.
+        A successful save prunes the WAL: the checkpoint now carries
+        everything the pruned records did."""
         from ..store import save_checkpoint
 
         async with self.core_lock:
             save_checkpoint(self.core.hg, path)
+            if self.core.wal is not None:
+                self.core.wal.checkpointed(self.core.seq, self.core.head)
 
     async def run(self, gossip: bool = True) -> None:
         """The select loop (reference node.go:119-147)."""
@@ -299,6 +326,10 @@ class Node:
             except (asyncio.CancelledError, Exception):
                 pass
         await self.transport.close()
+        if self.core.wal is not None:
+            # graceful close writes the head receipt, so the next boot
+            # trusts the (possibly just-pruned) log without a seq probe
+            self.core.wal.close(self.core.seq, self.core.head)
 
     # ------------------------------------------------------------------
     # inbound
@@ -610,6 +641,15 @@ class Node:
             self._m_gossip_events.inc(len(resp.events))
             self.tracer.record("sync_apply", t1 - t0,
                                events=len(resp.events))
+            if self.core.probing and self.core.probe_note(resp.from_addr):
+                # seq skip-ahead resolved: a supermajority answered, the
+                # engine head is the max published seq any of them saw
+                self.logger.warning(
+                    "seq probe complete: resuming mints at seq %d",
+                    self.core.seq + 1,
+                )
+                if self.core.head == "":
+                    self.core.init()
             # Consensus cadence (Config.consensus_interval > 0): the
             # pipeline runs in its own task (_consensus_loop), OFF the
             # gossip critical path — an 8-17 ms device pipeline call in

@@ -8,13 +8,13 @@ checkpointed via ``babble_tpu.store.checkpoint``.
 """
 
 from .checkpoint import (
-    engine_mode, load_checkpoint, load_snapshot, save_checkpoint,
-    snapshot_bytes,
+    engine_mode, load_checkpoint, load_checkpoint_tolerant, load_snapshot,
+    save_checkpoint, snapshot_bytes,
 )
 from .inmem import InmemStore, RoundEvent, RoundInfo, Store
 
 __all__ = [
     "Store", "InmemStore", "RoundInfo", "RoundEvent",
-    "save_checkpoint", "load_checkpoint", "snapshot_bytes", "load_snapshot",
-    "engine_mode",
+    "save_checkpoint", "load_checkpoint", "load_checkpoint_tolerant",
+    "snapshot_bytes", "load_snapshot", "engine_mode",
 ]

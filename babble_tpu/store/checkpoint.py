@@ -614,6 +614,23 @@ def load_snapshot(
     return engine
 
 
+def load_checkpoint_tolerant(
+    path: str,
+    commit_callback: Optional[Callable] = None,
+):
+    """Corruption-tolerant restart (the WAL recovery ladder's first
+    rung): try the checkpoint, and on ANY failure — missing files,
+    truncated msgpack, bit-rotted npz, validation errors — return
+    ``(None, reason)`` instead of crashing the boot.  The caller falls
+    back to a fresh engine plus WAL replay + gossip/fast-forward;
+    refusing to start over a disk fault would turn one rotten block
+    into a permanently dead node."""
+    try:
+        return load_checkpoint(path, commit_callback), None
+    except Exception as e:
+        return None, f"{type(e).__name__}: {e}"
+
+
 def load_checkpoint(
     path: str,
     commit_callback: Optional[Callable] = None,

@@ -90,14 +90,18 @@ class TestnetRunner:
     with_clients: bool = True
     ports: PortLayout = field(default_factory=PortLayout)
     extra_node_args: List[str] = field(default_factory=list)
-    #: run fork-aware nodes (accept + detect equivocations) — required
-    #: for crash/restart chaos: an honest node restarting from a stale
-    #: checkpoint reuses sequence numbers and reads as an equivocator
+    #: run fork-aware nodes (accept + detect equivocations).  No longer
+    #: required for crash/restart chaos: with `wal` on, an honest node
+    #: replays its write-ahead log at restart and resumes at its
+    #: published head seq instead of re-minting indexes
     byzantine: bool = False
     #: per-node checkpoint dirs + a tight save interval, so a killed
     #: node restarts from recent state instead of a fresh root
     checkpoints: bool = False
     checkpoint_interval_s: float = 5.0
+    #: per-node write-ahead logs (<datadir>/wal): restart recovery is
+    #: seq-exact — the crash-restart chaos scenarios run honest on this
+    wal: bool = False
     # N processes sharing one host must not fight over a single accelerator;
     # set to "" to let each node pick its own default platform.
     jax_platform: str = "cpu"
@@ -139,6 +143,11 @@ class TestnetRunner:
             args += ["--checkpoint_dir", os.path.join(d, "ckpt"),
                      "--checkpoint_interval",
                      str(self.checkpoint_interval_s)]
+        if self.wal:
+            # batch fsync: a kill -9 may tear the final record, which
+            # recovery truncates and the seq probe then covers
+            args += ["--wal_dir", os.path.join(d, "wal"),
+                     "--wal_fsync", "batch(32,50)"]
         if not self.with_clients:
             args.append("--no_client")
         return args

@@ -1,0 +1,410 @@
+"""Durable per-event write-ahead log (the consensus "head receipt").
+
+The ROADMAP's crash-recovery-amnesia defect (found by live chaos): an
+honest node restarting from a stale checkpoint re-mints sequence
+numbers it already published, peers read the duplicate indexes as an
+equivocation, and the restarted identity poisons a 3-node fleet at
+supermajority.  Protocol-aware storage fixes it at the source: every
+event a node inserts — and, critically, every self-event *before* it
+becomes gossipable — is appended to this log, so a restart replays the
+tail on top of the newest checkpoint and resumes at its true head seq
+(cf. Protocol-Aware Recovery for Consensus-Based Storage, FAST'18; the
+hashgraph model assumes a node never forgets its own head).
+
+Format — append-only segments ``seg-<n>.wal`` of CRC32-framed records::
+
+    [u32 payload length][u32 crc32(payload)][payload]
+
+where the payload is the checkpoint/byzantine-gossip ``FullWireEvent``
+msgpack tuple (one event encoding to evolve, not three).  Recovery
+scans segments in order and **truncates at the first torn or corrupt
+record instead of crashing**: a short header, a zero/garbage length, a
+short payload, a CRC mismatch or an undecodable payload all end the
+log there — the file is physically truncated to the last whole record,
+later segments are discarded (they were written after the corruption
+point, so their ordering context is gone), and the damage is counted
+on ``babble_wal_truncated_records_total``.
+
+Fsync policy (``FsyncPolicy.parse``):
+
+- ``always``    — flush + fsync on every append (no acked event can be
+  lost, torn tails only for the in-flight record);
+- ``batch(n,ms)`` (also accepted as ``batch:n,ms`` / bare ``batch``) —
+  flush every append, fsync when ``n`` appends or ``ms`` milliseconds
+  accumulated since the last sync; a crash can lose at most one batch,
+  which the restart-time seq probe (node/core.py) covers;
+- ``off``       — flush only, never fsync: the tier-1 test fast path
+  (in-process durability without paying the disk).
+
+Beside the records the directory holds a tiny **head receipt**
+(``head.receipt``: msgpack ``[seq, head_hex]``), written atomically on
+clean close and after every checkpoint prune.  The receipt lets a
+restart distinguish "WAL legitimately empty (just pruned / clean
+shutdown)" from "WAL missing entirely" — only the latter falls back to
+the peer-negotiated seq skip-ahead probe.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+import time
+import zlib
+from typing import List, Optional, Tuple
+
+import msgpack
+
+from ..core.event import Event, FullWireEvent
+from ..obs import Registry
+
+_HDR = struct.Struct("<II")
+#: sanity bound on one record — a length past this reads as corruption,
+#: not as an instruction to allocate gigabytes
+MAX_RECORD = 1 << 24
+
+_SEG_RE = re.compile(r"^seg-(\d{8})\.wal$")
+_RECEIPT = "head.receipt"
+#: present only between a graceful close and the next open — its
+#: absence at boot means the previous incarnation crashed, and under a
+#: batched fsync policy a crash can lose a whole SUFFIX of records
+#: ending exactly at the last fsync boundary (no torn tail to detect),
+#: so an unclean shutdown must arm the seq probe
+_CLEAN = "clean"
+
+
+class FsyncPolicy:
+    """Parsed fsync policy: ``always`` / ``batch(n,ms)`` / ``off``."""
+
+    __slots__ = ("mode", "batch_n", "batch_ms")
+
+    def __init__(self, mode: str, batch_n: int = 64, batch_ms: float = 50.0):
+        if mode not in ("always", "batch", "off"):
+            raise ValueError(f"unknown fsync mode {mode!r}")
+        if batch_n < 1 or batch_ms < 0:
+            raise ValueError(
+                f"batch fsync wants n >= 1 and ms >= 0, got ({batch_n}, {batch_ms})"
+            )
+        self.mode = mode
+        self.batch_n = batch_n
+        self.batch_ms = batch_ms
+
+    @classmethod
+    def parse(cls, spec: str) -> "FsyncPolicy":
+        s = (spec or "batch").strip().lower()
+        if s in ("always", "off"):
+            return cls(s)
+        m = re.fullmatch(r"batch(?:[(:]([0-9]+)\s*,\s*([0-9.]+)\)?)?", s)
+        if not m:
+            raise ValueError(
+                f"unknown fsync policy {spec!r}; want always, off, or "
+                "batch(n,ms)"
+            )
+        if m.group(1) is None:
+            return cls("batch")
+        return cls("batch", int(m.group(1)), float(m.group(2)))
+
+    def __repr__(self) -> str:
+        if self.mode == "batch":
+            return f"batch({self.batch_n},{self.batch_ms:g})"
+        return self.mode
+
+
+def _pack_record(ev: Event) -> bytes:
+    payload = msgpack.packb(FullWireEvent.from_event(ev).pack(),
+                            use_bin_type=True)
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+class WriteAheadLog:
+    """One node's event WAL.  Construction performs recovery: segments
+    are scanned, the tail is truncated at the first bad record, and the
+    surviving events are exposed as ``recovered_events`` for the Core
+    to replay on top of its checkpoint.  Appends then continue into a
+    fresh segment."""
+
+    def __init__(
+        self,
+        path: str,
+        fsync: str = "batch",
+        segment_bytes: int = 4 << 20,
+        registry: Optional[Registry] = None,
+    ):
+        self.dir = path
+        self.policy = FsyncPolicy.parse(fsync)
+        self.segment_bytes = int(segment_bytes)
+        self._closed = False
+        self._pending = 0
+        # monotonic is pacing, not a wall clock: it drives only the
+        # batch-fsync deadline, never event bodies (those go through
+        # Core.now_ns)
+        self._clock = time.monotonic
+        self._last_sync = self._clock()
+        self._bind_metrics(registry if registry is not None else Registry())
+
+        os.makedirs(path, exist_ok=True)
+        self.receipt: Optional[Tuple[int, str]] = self._read_receipt()
+        clean_path = os.path.join(path, _CLEAN)
+        self.had_clean_close = os.path.isfile(clean_path)
+        if self.had_clean_close:
+            os.remove(clean_path)   # we are the running incarnation now
+        self.recovered_events: List[Event] = []
+        self.truncated_records = 0
+        self._seg_index = self._scan()
+        self._m_truncated.inc(self.truncated_records)
+
+        self._active_path = os.path.join(
+            self.dir, f"seg-{self._seg_index:08d}.wal"
+        )
+        self._active = open(self._active_path, "ab")
+        self._size = self._active.tell()
+
+    # ------------------------------------------------------------------
+    # metrics
+
+    def _bind_metrics(self, registry: Registry) -> None:
+        self._m_appended = registry.counter(
+            "babble_wal_appended_total",
+            "events appended to the write-ahead log")
+        self._m_fsync = registry.histogram(
+            "babble_wal_fsync_seconds",
+            "WAL flush+fsync wall time per sync")
+        self._m_replayed = registry.counter(
+            "babble_wal_replayed_events_total",
+            "events replayed from the WAL tail at recovery")
+        self._m_truncated = registry.counter(
+            "babble_wal_truncated_records_total",
+            "WAL records lost to torn/corrupt tails at recovery "
+            "(corruption points plus records in discarded later segments)")
+
+    def mark_replayed(self, n: int) -> None:
+        """Count events the Core actually re-inserted at recovery."""
+        if n > 0:
+            self._m_replayed.inc(n)
+
+    # ------------------------------------------------------------------
+    # recovery
+
+    @property
+    def is_fresh(self) -> bool:
+        """True when the directory held neither records nor a head
+        receipt — the node has no durable memory of its own chain and
+        must seq-probe its peers before minting anything."""
+        return not self.recovered_events and self.receipt is None
+
+    @property
+    def needs_probe(self) -> bool:
+        """True when recovery cannot vouch that every PUBLISHED seq
+        survived, so minting must wait for the peer-negotiated
+        skip-ahead: the log is missing entirely, its tail was
+        torn/corrupt, or the previous incarnation crashed under a
+        batched/disabled fsync policy — there a whole suffix of
+        records can be lost at a clean fsync boundary with nothing
+        left to detect.  ``fsync=always`` is exempt on the last arm:
+        every append fsyncs before the event can gossip, so only the
+        in-flight record can be lost (the torn-tail arm catches it)."""
+        if self.is_fresh or self.truncated_records > 0:
+            return True
+        return self.policy.mode != "always" and not self.had_clean_close
+
+    @property
+    def receipt_seq(self) -> int:
+        return self.receipt[0] if self.receipt is not None else -1
+
+    def _read_receipt(self) -> Optional[Tuple[int, str]]:
+        try:
+            with open(os.path.join(self.dir, _RECEIPT), "rb") as f:
+                seq, head = msgpack.unpackb(f.read(), raw=False)
+            if not isinstance(seq, int) or not isinstance(head, str):
+                return None
+            return (seq, head)
+        except (OSError, ValueError, msgpack.exceptions.UnpackException,
+                TypeError):
+            # disk rot may hit the receipt too — an unreadable receipt
+            # is the same as a missing one (the probe path covers it)
+            return None
+
+    def _segments(self) -> List[Tuple[int, str]]:
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            m = _SEG_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.dir, name)))
+        out.sort()
+        return out
+
+    def _scan(self) -> int:
+        """Recover every whole record; returns the index the next
+        (fresh) active segment should use."""
+        segs = self._segments()
+        next_index = (segs[-1][0] + 1) if segs else 0
+        for si, (_, seg_path) in enumerate(segs):
+            with open(seg_path, "rb") as f:
+                data = f.read()
+            good = self._scan_segment(data)
+            if good is None:
+                continue
+            # torn/corrupt tail: truncate the file to the last whole
+            # record and discard every LATER segment — records after
+            # the corruption point lost their ordering context.  The
+            # counter reflects actual damage: 1 for the corruption
+            # point plus every decodable record in the discarded
+            # segments (an operator triaging disk rot must not see a
+            # hundred-record loss reported as 1).
+            self.truncated_records += 1
+            with open(seg_path, "r+b") as f:
+                f.truncate(good)
+            for _, later in segs[si + 1:]:
+                with open(later, "rb") as f:
+                    self.truncated_records += self._count_records(f.read())
+                os.remove(later)
+            break
+        return next_index
+
+    @staticmethod
+    def _count_records(data: bytes) -> int:
+        """Whole records in a segment being discarded (count only)."""
+        off, n, count = 0, len(data), 0
+        while off + _HDR.size <= n:
+            length, _ = _HDR.unpack_from(data, off)
+            if length == 0 or length > MAX_RECORD or off + _HDR.size + length > n:
+                break
+            count += 1
+            off += _HDR.size + length
+        return count
+
+    def _scan_segment(self, data: bytes) -> Optional[int]:
+        """Decode records from one segment into ``recovered_events``.
+        Returns None if the whole segment was clean, else the byte
+        offset of the first bad record (the truncation point)."""
+        off = 0
+        n = len(data)
+        while off < n:
+            if n - off < _HDR.size:
+                return off          # torn header
+            length, crc = _HDR.unpack_from(data, off)
+            if length == 0 or length > MAX_RECORD or off + _HDR.size + length > n:
+                return off          # zero-fill / garbage length / torn payload
+            payload = data[off + _HDR.size: off + _HDR.size + length]
+            if zlib.crc32(payload) != crc:
+                return off          # bit rot
+            try:
+                ev = FullWireEvent.unpack(
+                    msgpack.unpackb(payload, raw=False)
+                ).to_event()
+            except Exception:
+                return off          # CRC-valid but undecodable payload
+            self.recovered_events.append(ev)
+            off += _HDR.size + length
+        return None
+
+    # ------------------------------------------------------------------
+    # append path
+
+    def append(self, event: Event) -> None:
+        """Durably record one event per the fsync policy.  Called for
+        every event the Core inserts; for self-created events the call
+        happens BEFORE the engine insert that makes them gossipable —
+        that ordering is the whole point of the log (babble-lint
+        ``wal-before-gossip`` pins it at the mint sites)."""
+        if self._closed:
+            raise ValueError("write-ahead log is closed")
+        buf = _pack_record(event)
+        self._active.write(buf)
+        self._size += len(buf)
+        self._pending += 1
+        self._m_appended.inc()
+        self._sync_per_policy()
+        if self._size >= self.segment_bytes:
+            self._rotate()
+
+    def _sync_per_policy(self) -> None:
+        p = self.policy
+        if p.mode == "off":
+            self._active.flush()
+            return
+        due = (
+            p.mode == "always"
+            or self._pending >= p.batch_n
+            or (self._clock() - self._last_sync) * 1e3 >= p.batch_ms
+        )
+        self._active.flush()
+        if due:
+            self._fsync_active()
+
+    def _fsync_active(self) -> None:
+        t0 = time.perf_counter()
+        os.fsync(self._active.fileno())
+        self._m_fsync.observe(time.perf_counter() - t0)
+        self._pending = 0
+        self._last_sync = self._clock()
+
+    def _rotate(self) -> None:
+        if self.policy.mode != "off":
+            self._active.flush()
+            self._fsync_active()
+        self._active.close()
+        self._seg_index += 1
+        self._active_path = os.path.join(
+            self.dir, f"seg-{self._seg_index:08d}.wal"
+        )
+        self._active = open(self._active_path, "ab")
+        self._size = 0
+
+    # ------------------------------------------------------------------
+    # checkpoint coordination / shutdown
+
+    def _write_receipt(self, seq: int, head: str) -> None:
+        tmp = os.path.join(self.dir, _RECEIPT + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb([int(seq), head], use_bin_type=True))
+            f.flush()
+            if self.policy.mode != "off":
+                os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.dir, _RECEIPT))
+        self.receipt = (int(seq), head)
+
+    def checkpointed(self, seq: int, head: str) -> None:
+        """A checkpoint covering everything appended so far was just
+        saved (caller holds the core lock): rotate to a fresh segment
+        and prune the records the checkpoint now carries.  The head
+        receipt keeps the true head seq durable even through the
+        empty-log window right after a prune."""
+        if self._closed:
+            return
+        self._write_receipt(seq, head)
+        self._rotate()
+        for idx, seg_path in self._segments():
+            if idx < self._seg_index:
+                os.remove(seg_path)
+
+    def close(self, seq: Optional[int] = None, head: str = "") -> None:
+        """Graceful shutdown: final fsync, a head receipt, and the
+        clean marker — so the next boot trusts the (possibly empty)
+        log without a probe."""
+        if self._closed:
+            return
+        if self.policy.mode != "off":
+            self._active.flush()
+            self._fsync_active()
+        else:
+            self._active.flush()
+        if seq is not None:
+            self._write_receipt(seq, head)
+        with open(os.path.join(self.dir, _CLEAN), "wb") as f:
+            f.write(b"")
+        self._active.close()
+        self._closed = True
+
+    def abort(self) -> None:
+        """Crash-style close: drop the handles, write NO receipt.  The
+        chaos runner uses this so a simulated crash leaves exactly what
+        a real power cut would."""
+        if self._closed:
+            return
+        self._active.close()
+        self._closed = True

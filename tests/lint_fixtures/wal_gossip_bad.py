@@ -1,0 +1,36 @@
+"""Fixture: wal-before-gossip — self events minted and inserted into
+the node's own engine with no write-ahead append anywhere in the call
+closure.  A crash after these mints forgets the published seqs; the
+restart re-mints them and peers read the node as an equivocator."""
+
+
+class AmnesiacCore:
+    def __init__(self, key, engine):
+        self.key = key
+        self.engine = engine
+        self.head = ""
+        self.seq = -1
+
+    def mint(self, payload, other_head):
+        ev = new_event(  # MARK: wal-before-gossip
+            payload, (self.head, other_head), self.key.pub_bytes,
+            self.seq + 1,
+        )
+        ev.sign(self.key)
+        self.engine.insert_event(ev)
+        self.head = ev.hex()
+        self.seq = ev.index
+
+    def mint_via_helper(self, payload):
+        # the insert hides in a helper: the closure still sees it
+        ev = new_event(  # MARK: wal-before-gossip
+            payload, (self.head, self.head), self.key.pub_bytes,
+            self.seq + 1,
+        )
+        self._sign_and_insert(ev)
+
+    def _sign_and_insert(self, ev):
+        ev.sign(self.key)
+        self.engine.insert_event(ev)
+        self.head = ev.hex()
+        self.seq = ev.index

@@ -1,0 +1,61 @@
+"""Fixture: wal-before-gossip negative cases — mint paths that DO pass
+through wal.append (directly or via a helper), plus shapes the rule
+must leave alone (free-function DAG builders, inserts into another
+node's engine)."""
+
+
+class DurableCore:
+    def __init__(self, key, engine, wal):
+        self.key = key
+        self.engine = engine
+        self.wal = wal
+        self.head = ""
+        self.seq = -1
+
+    def mint(self, payload, other_head):
+        ev = new_event(
+            payload, (self.head, other_head), self.key.pub_bytes,
+            self.seq + 1,
+        )
+        ev.sign(self.key)
+        self.wal.append(ev)          # logged before it can gossip
+        self.engine.insert_event(ev)
+        self.head = ev.hex()
+        self.seq = ev.index
+
+    def mint_via_helper(self, payload):
+        ev = new_event(
+            payload, (self.head, self.head), self.key.pub_bytes,
+            self.seq + 1,
+        )
+        self._sign_and_insert(ev)
+
+    def _sign_and_insert(self, ev):
+        ev.sign(self.key)
+        self._wal_append(ev)         # helper spelling counts too
+        self.engine.insert_event(ev)
+        self.head = ev.hex()
+        self.seq = ev.index
+
+    def _wal_append(self, ev):
+        if self.wal is not None:
+            self.wal.append(ev)
+
+    def plant_at_target(self, target, payload):
+        # inserting into ANOTHER node's engine is an attack/injection
+        # shape (chaos fork injector), not our gossip path — clean
+        ev = new_event(payload, (self.head, self.head),
+                       self.key.pub_bytes, self.seq + 1)
+        ev.sign(self.key)
+        target.core.insert_event(ev)
+
+
+def build_test_dag(pubs):
+    # free functions minting unsigned-for-real test events carry no
+    # node identity and no durability contract — clean
+    events = []
+    for pub in pubs:
+        ev = new_event([], ("", ""), pub, 0)
+        ev.sign(pub)
+        events.append(ev)
+    return events

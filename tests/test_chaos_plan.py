@@ -251,8 +251,24 @@ def test_checker_liveness_uses_heal_window():
 
 def test_canned_catalog_covers_issue_list():
     assert {"flaky-link", "minority-partition",
-            "crash-restart-with-fast-forward", "fork-attack",
+            "crash-restart", "disk-rot", "fork-attack",
             "slow-peer"} <= set(CANNED)
     for name, spec in CANNED.items():
         sc = Scenario.from_dict(spec)   # validates
         assert sc.name == name
+
+
+def test_disk_fault_schema_roundtrips_and_validates():
+    from babble_tpu.chaos import DiskFaults, FaultPlan
+
+    plan = FaultPlan.from_dict({
+        "crashes": [{"node": 1, "crash": 5, "restart": 9}],
+        "disk": {"checkpoint_corrupt": 0.5, "wal_truncate": 1.0},
+    })
+    assert plan.disk.checkpoint_corrupt == 0.5
+    assert plan.disk.wal_corrupt == 0.0
+    assert FaultPlan.from_dict(plan.to_dict()).disk == plan.disk
+    with pytest.raises(ValueError):
+        DiskFaults.from_dict({"wal_melt": 1.0})
+    with pytest.raises(ValueError):
+        DiskFaults(wal_corrupt=1.5)

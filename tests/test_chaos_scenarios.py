@@ -43,6 +43,30 @@ _MINI_FORK = {
     "plan": {"byzantine": {"node": 3, "mode": "fork", "at": 16}},
 }
 
+#: honest-mode durable crash/restart (ISSUE 5): the runner gives every
+#: node a real on-disk WAL, the crash discards the live engine, and
+#: recovery replays the log — seq-exact, so no fork-aware workaround
+_MINI_CRASH = {
+    "name": "mini-crash", "nodes": 3, "steps": 110, "seed": 5,
+    "txs": 6, "tx_every": 8, "settle_rounds": 4, "liveness_bound": 60,
+    "invariants": ["prefix_agreement", "liveness"],
+    "plan": {"crashes": [{"node": 2, "crash": 20, "restart": 44}]},
+}
+
+#: durable-state rot on restart: stale checkpoint with a flipped byte,
+#: WAL with a torn tail — recovery must degrade through the ladder
+_MINI_DISKROT = {
+    "name": "mini-disk-rot", "nodes": 3, "steps": 130, "seed": 5,
+    "cache_size": 1024,
+    "txs": 6, "tx_every": 10, "settle_rounds": 4, "liveness_bound": 70,
+    "checkpoint_every": 16,
+    "invariants": ["prefix_agreement", "liveness"],
+    "plan": {
+        "crashes": [{"node": 2, "crash": 40, "restart": 60}],
+        "disk": {"checkpoint_corrupt": 1.0, "wal_truncate": 1.0},
+    },
+}
+
 
 def test_fixed_seed_is_bit_for_bit_reproducible():
     """Identical fault schedule and identical committed order across
@@ -100,6 +124,38 @@ def test_broken_fork_attack_fails_loudly():
     assert kinds == {"fork_detected"}, r.report.format()
     # loud: the formatted report names the invariant and the cause
     assert "INVARIANT VIOLATION" in r.report.format()
+
+
+def test_honest_crash_restart_recovers_through_the_wal():
+    """The ISSUE-5 acceptance shape in miniature: an honest (non-fork-
+    aware) node crashes mid-run, restarts from its on-disk WAL, resumes
+    at its published head seq, and the fleet agrees — no equivocation,
+    no fork-aware crutch."""
+    sc = Scenario.from_dict(_MINI_CRASH)
+    r = run_scenario(sc)
+    assert r.report.ok, r.report.format()
+    assert r.restarted == {2}
+    # honest engines would register the re-mint as insert failures on
+    # every peer; seq-exact recovery means none of that happened and
+    # nobody ever flagged an equivocation
+    assert not any(r.fork_detected.values()), r.fork_detected
+    # the restarted node made post-restart progress
+    assert r.consensus_counts_final[2] > 0
+
+
+def test_disk_rot_recovers_and_is_reproducible():
+    """Seeded disk faults fire at restart (they land in fault_counts
+    like any injected fault), recovery degrades through the ladder
+    without violating prefix agreement, and the whole run — disk rot
+    included — replays bit-for-bit from the seed."""
+    sc = Scenario.from_dict(_MINI_DISKROT)
+    a = run_scenario(sc)
+    assert a.report.ok, a.report.format()
+    assert a.fault_counts.get("checkpoint_corrupt", 0) == 1, a.fault_counts
+    assert a.fault_counts.get("wal_truncate", 0) == 1, a.fault_counts
+    b = run_scenario(sc)
+    assert a.fingerprint() == b.fingerprint()
+    assert a.fault_schedule == b.fault_schedule
 
 
 def test_crash_without_restart_still_produces_a_report():

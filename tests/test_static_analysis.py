@@ -66,7 +66,7 @@ def test_rule_catalog_well_formed():
             "await-state-race", "asyncio-blocking-call",
             "drain-before-validate", "falsy-or-fallback",
             "chaos-unseeded-random", "consensus-nondeterminism",
-            "held-guard-escape"} <= set(names)
+            "held-guard-escape", "wal-before-gossip"} <= set(names)
 
 
 def test_every_suppression_in_tree_names_a_rule():
@@ -242,6 +242,37 @@ def test_guard_fixture_findings():
     assert ok == [], [f.format() for f in ok]
 
 
+def test_wal_gossip_fixture_findings():
+    """A method that constructs-and-inserts a new self event without
+    passing through wal.append in its call closure is flagged (the
+    ISSUE-5 durability discipline); WAL-routed mints — direct or via a
+    helper — plus free-function DAG builders and plants into ANOTHER
+    node's engine stay clean."""
+    path = _fixture("wal_gossip_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "wal-before-gossip") == _marked_lines(
+        path, "wal-before-gossip"
+    ), [f.format() for f in findings]
+    assert len(findings) == 2, [f.format() for f in findings]
+
+    ok = check_file(_fixture("wal_gossip_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_wal_gossip_rule_passes_the_real_core():
+    """node/core.py is where the rule earns its keep: every Core mint
+    path (init / sync / add_self_event) routes through
+    sign_and_insert_self_event -> _wal_append, and the project-wide
+    pass must see that closure as clean — no suppression needed."""
+    core_path = os.path.join(PKG, "node", "core.py")
+    findings = run_paths([PKG], ALL_RULES, known_rules=RULE_NAMES,
+                         include_suppressed=True)
+    assert [f for f in findings
+            if f.rule == "wal-before-gossip"
+            and f.path == core_path] == []
+
+
 def test_stale_suppression_fixture_findings():
     """A suppression whose rule no longer fires on its line is itself a
     finding, anchored at the comment; a live suppression is not."""
@@ -344,7 +375,7 @@ def test_cli_exits_nonzero_with_locations_on_fixtures():
                  "asyncio-blocking-call", "drain-before-validate",
                  "falsy-or-fallback", "chaos-unseeded-random",
                  "consensus-nondeterminism", "held-guard-escape",
-                 "stale-suppression"):
+                 "stale-suppression", "wal-before-gossip"):
         assert rule in proc.stdout, (rule, proc.stdout)
     import re
 

@@ -1,0 +1,475 @@
+"""Durability plane (babble_tpu/wal): the ISSUE-5 acceptance pins.
+
+- append/recover round-trips resume a Core at its published head seq
+  (the crash-recovery-amnesia fix: a restart never re-mints an index);
+- torn-write goldens: a mid-record truncation, a flipped CRC byte and a
+  zero-fill tail each recover to the last whole record — counted on
+  ``babble_wal_truncated_records_total`` — and the node rejoins through
+  the deferred-mint / gossip path instead of equivocating;
+- checkpoint + WAL-prune round trip (the recovery ladder's first rung);
+- the WAL-missing fallback: peer-negotiated seq skip-ahead (the probe);
+- corruption-tolerant checkpoint loading (load_checkpoint_tolerant).
+
+Everything runs with ``fsync=off`` (flush-only) so the tier-1 tests
+stay sub-second; the policy itself is covered by dedicated parse /
+batch-cadence tests.
+"""
+
+import os
+
+import pytest
+
+from babble_tpu.core.event import new_event
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.node.core import Core
+from babble_tpu.obs import Registry
+from babble_tpu.wal import FsyncPolicy, WriteAheadLog
+
+
+def _participants(n=3):
+    keys = sorted([generate_key() for _ in range(n)],
+                  key=lambda k: k.pub_hex)
+    return keys, {k.pub_hex: i for i, k in enumerate(keys)}
+
+
+def _make_core(idx, keys, participants, wal):
+    return Core(idx, keys[idx], participants, e_cap=256, wal=wal)
+
+
+def _complete_probe(core):
+    """First boot over a fresh WAL defers minting behind the seq probe
+    (by design); feed it a quorum of pretend sync partners."""
+    for peer in ("probe-a", "probe-b", "probe-c"):
+        if not core.probing:
+            return
+        core.probe_note(peer)
+
+
+def _chain(key, n, ts0=1_000_000):
+    """n signed self-chained events under one key (WAL payload stock)."""
+    out, head = [], ""
+    for i in range(n):
+        ev = new_event([f"p{i}".encode()], (head, head), key.pub_bytes, i,
+                       timestamp=ts0 + i)
+        ev.sign(key)
+        head = ev.hex()
+        out.append(ev)
+    return out
+
+
+def _segment(wal_dir):
+    segs = sorted(f for f in os.listdir(wal_dir) if f.endswith(".wal")
+                  and os.path.getsize(os.path.join(wal_dir, f)) > 0)
+    assert segs, os.listdir(wal_dir)
+    return os.path.join(wal_dir, segs[-1])
+
+
+# ----------------------------------------------------------------------
+# fsync policy
+
+
+def test_fsync_policy_parse():
+    assert FsyncPolicy.parse("always").mode == "always"
+    assert FsyncPolicy.parse("off").mode == "off"
+    assert FsyncPolicy.parse("").mode == "batch"      # unset -> default
+    p = FsyncPolicy.parse("batch")
+    assert (p.batch_n, p.batch_ms) == (64, 50.0)
+    for spec in ("batch(8,25)", "batch:8,25", "BATCH(8,25)"):
+        p = FsyncPolicy.parse(spec)
+        assert (p.mode, p.batch_n, p.batch_ms) == ("batch", 8, 25.0)
+    for bad in ("sometimes", "batch(x,1)", "batch(8)", "batch(0,5)"):
+        with pytest.raises(ValueError):
+            FsyncPolicy.parse(bad)
+
+
+def test_batch_policy_fsyncs_on_count_and_off_never_does(tmp_path):
+    key = generate_key()
+    evs = _chain(key, 5)
+    reg = Registry()
+    wal = WriteAheadLog(str(tmp_path / "w1"), fsync="batch(2,100000)",
+                        registry=reg)
+    for ev in evs:
+        wal.append(ev)
+    # 5 appends at n=2 (and an effectively-infinite ms deadline):
+    # fsyncs fired on the count trigger alone
+    assert wal._m_fsync.count >= 2
+    wal.close(evs[-1].index, evs[-1].hex())
+
+    reg2 = Registry()
+    off = WriteAheadLog(str(tmp_path / "w2"), fsync="off", registry=reg2)
+    for ev in evs:
+        off.append(ev)
+    assert off._m_fsync.count == 0
+    off.close(evs[-1].index, evs[-1].hex())
+    assert reg2.get("babble_wal_appended_total").value == 5
+
+
+# ----------------------------------------------------------------------
+# round trip + seq-exact resume
+
+
+def test_crash_recovery_resumes_at_published_head_seq(tmp_path):
+    """The amnesia fix end to end: mint, crash (no receipt), reboot a
+    FRESH engine over the same WAL — the node resumes at its true head
+    and the next mint extends the chain instead of re-minting."""
+    keys, parts = _participants(3)
+    wal_dir = str(tmp_path / "wal")
+    reg = Registry()
+    core = _make_core(0, keys, parts,
+                      WriteAheadLog(wal_dir, fsync="off", registry=reg))
+    core.now_ns = iter(range(10**6, 10**7, 1000)).__next__
+    _complete_probe(core)
+    core.init()
+    assert core.add_self_event([b"tx-1"])
+    assert core.add_self_event([b"tx-2"])
+    assert core.seq == 2
+    head, seq = core.head, core.seq
+    core.wal.abort()                       # power cut
+
+    reg2 = Registry()
+    wal2 = WriteAheadLog(wal_dir, fsync="off", registry=reg2)
+    assert len(wal2.recovered_events) == 3
+    core2 = _make_core(0, keys, parts, wal2)
+    assert (core2.head, core2.seq) == (head, seq)
+    # an UNCLEAN shutdown under a batched/off fsync policy arms the
+    # probe even with a clean-scanning log: a lost suffix ending at a
+    # fsync boundary is undetectable, so a supermajority must confirm
+    # the head before minting resumes — at the replayed seq, since the
+    # log did in fact hold everything
+    assert core2.probing and core2.mint_blocked()
+    assert reg2.get("babble_wal_replayed_events_total").value == 3
+    _complete_probe(core2)
+    assert not core2.mint_blocked()
+    core2.now_ns = iter(range(10**8, 10**9, 1000)).__next__
+    assert core2.add_self_event([b"tx-3"])
+    assert core2.seq == seq + 1            # extended, never re-minted
+
+
+def test_always_policy_skips_the_probe_after_a_crash(tmp_path):
+    """fsync=always fsyncs before an event can gossip, so a crash with
+    a clean-scanning log IS trustworthy — replay resumes minting with
+    no probe round."""
+    keys, parts = _participants(3)
+    wal_dir = str(tmp_path / "wal")
+    core = _make_core(0, keys, parts,
+                      WriteAheadLog(wal_dir, fsync="always"))
+    core.now_ns = iter(range(10**6, 10**7, 1000)).__next__
+    _complete_probe(core)
+    core.init()
+    core.add_self_event([b"tx"])
+    core.wal.abort()
+
+    core2 = _make_core(0, keys, parts,
+                       WriteAheadLog(wal_dir, fsync="always"))
+    assert not core2.probing and not core2.mint_blocked()
+    assert core2.seq == 1
+
+
+def test_peer_events_ride_the_wal_through_sync(tmp_path):
+    """Core.sync WALs the peer events it inserts, so recovery rebuilds
+    the full inserted window, not just our own chain."""
+    keys, parts = _participants(2)
+    w0 = WriteAheadLog(str(tmp_path / "w0"), fsync="off")
+    a = _make_core(0, keys, parts, w0)
+    b = _make_core(1, keys, parts, None)
+    clk = iter(range(10**6, 10**7, 1000))
+    a.now_ns = b.now_ns = clk.__next__
+    _complete_probe(a)
+    a.init()
+    b.init()
+    # b -> a: a inserts b's root and mints a merge head
+    wire = b.to_wire(b.diff(a.known()))
+    assert a.sync(b.head, wire, [b"tx"]) is True
+    a.wal.abort()
+
+    wal2 = WriteAheadLog(str(tmp_path / "w0"), fsync="off")
+    # a's root + b's root + a's merge event
+    assert len(wal2.recovered_events) == 3
+    a2 = _make_core(0, keys, parts, wal2)
+    assert a2.seq == 1 and a2.head == a.head
+    assert b.head in a2.hg.dag.slot_of
+
+
+# ----------------------------------------------------------------------
+# torn-write goldens
+
+
+def _build_damaged(tmp_path, damage):
+    """Write 4 records, crash, apply ``damage`` to the segment, then
+    recover.  Returns (wal, events, registry)."""
+    key = generate_key()
+    evs = _chain(key, 4)
+    wal_dir = str(tmp_path / "wal")
+    wal = WriteAheadLog(wal_dir, fsync="off")
+    for ev in evs:
+        wal.append(ev)
+    wal.abort()
+    seg = _segment(wal_dir)
+    damage(seg)
+    reg = Registry()
+    return WriteAheadLog(wal_dir, fsync="off", registry=reg), evs, reg
+
+
+def test_golden_mid_record_truncation_recovers_prefix(tmp_path):
+    def chop(seg):
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.truncate(size - 11)          # tear the final record
+
+    wal, evs, reg = _build_damaged(tmp_path, chop)
+    assert [e.hex() for e in wal.recovered_events] == \
+        [e.hex() for e in evs[:3]]
+    assert wal.truncated_records == 1
+    assert reg.get("babble_wal_truncated_records_total").value == 1
+
+
+def test_golden_flipped_crc_byte_truncates_at_damage(tmp_path):
+    def flip(seg):
+        size = os.path.getsize(seg)
+        off = size - 5                     # inside the last payload
+        with open(seg, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0x41]))
+
+    wal, evs, reg = _build_damaged(tmp_path, flip)
+    assert [e.hex() for e in wal.recovered_events] == \
+        [e.hex() for e in evs[:3]]
+    assert reg.get("babble_wal_truncated_records_total").value == 1
+
+
+def test_golden_zero_fill_tail_recovers_all_records(tmp_path):
+    def zeros(seg):
+        with open(seg, "ab") as f:
+            f.write(b"\x00" * 64)          # preallocated-but-unwritten tail
+
+    wal, evs, reg = _build_damaged(tmp_path, zeros)
+    assert [e.hex() for e in wal.recovered_events] == \
+        [e.hex() for e in evs]
+    assert reg.get("babble_wal_truncated_records_total").value == 1
+
+
+def test_truncated_wal_defers_minting_behind_the_probe(tmp_path):
+    """A torn tail may have lost a published record: the Core must not
+    mint until a supermajority of sync partners confirmed our head —
+    then minting resumes one past the max anyone saw."""
+    keys, parts = _participants(3)
+    wal_dir = str(tmp_path / "wal")
+    core = _make_core(
+        0, keys, parts, WriteAheadLog(wal_dir, fsync="off"))
+    core.now_ns = iter(range(10**6, 10**7, 1000)).__next__
+    _complete_probe(core)
+    core.init()
+    core.add_self_event([b"tx"])
+    core.wal.abort()
+    with open(_segment(wal_dir), "r+b") as f:
+        f.truncate(os.path.getsize(_segment(wal_dir)) - 3)
+
+    core2 = _make_core(0, keys, parts,
+                       WriteAheadLog(wal_dir, fsync="off"))
+    assert core2.probing and core2.mint_blocked()
+    assert core2.add_self_event([b"nope"]) is False
+    core2.init()                           # also a no-op while probing
+    assert core2.head != "" and core2.seq == 0   # the intact record
+    # quorum for n=3 (counting ourselves) = 2 peers
+    assert core2.probe_note("peer-a") is False
+    assert core2.probe_note("peer-a") is False   # dedup by peer
+    assert core2.probe_note("peer-b") is True
+    assert not core2.mint_blocked()
+    assert core2.add_self_event([b"ok"]) is True
+    assert core2.seq == 1
+
+
+def test_missing_wal_probes_before_the_first_mint(tmp_path):
+    """The WAL-missing-entirely fallback: no records, no receipt — the
+    node has no durable memory, so even the root mint waits for the
+    first sync round's supermajority confirmation."""
+    keys, parts = _participants(3)
+    core = _make_core(
+        0, keys, parts,
+        WriteAheadLog(str(tmp_path / "fresh"), fsync="off"))
+    assert core.wal.is_fresh and core.probing
+    core.init()
+    assert core.head == "" and core.seq == -1
+    core.probe_note("peer-a")
+    assert core.probe_note("peer-b") is True
+    core.now_ns = iter(range(10**6, 10**7, 1000)).__next__
+    core.init()
+    assert core.seq == 0                   # nobody knew us: root is safe
+
+
+# ----------------------------------------------------------------------
+# checkpoint coordination
+
+
+def test_checkpoint_prunes_wal_and_resume_replays_the_tail(tmp_path):
+    """The ladder's first rung: checkpoint + WAL tail = full state.
+    After a prune the WAL holds only post-checkpoint records, and a
+    clean close's head receipt means no probe on the next boot."""
+    from babble_tpu.store import load_checkpoint, save_checkpoint
+
+    keys, parts = _participants(3)
+    wal_dir = str(tmp_path / "wal")
+    ckpt = str(tmp_path / "ckpt")
+    core = _make_core(0, keys, parts,
+                      WriteAheadLog(wal_dir, fsync="off"))
+    core.now_ns = iter(range(10**6, 10**7, 1000)).__next__
+    _complete_probe(core)
+    core.init()
+    core.add_self_event([b"pre-1"])
+    save_checkpoint(core.hg, ckpt)
+    core.wal.checkpointed(core.seq, core.head)
+    core.add_self_event([b"post-1"])       # the tail the crash keeps
+    core.add_self_event([b"post-2"])
+    core.wal.abort()
+
+    wal2 = WriteAheadLog(wal_dir, fsync="off")
+    assert len(wal2.recovered_events) == 2          # tail only
+    assert wal2.receipt_seq == 1                    # pruned-state floor
+    engine = load_checkpoint(ckpt)
+    core2 = Core(0, keys[0], parts, engine=engine, wal=wal2)
+    assert (core2.head, core2.seq) == (core.head, core.seq)
+    # crash-style close + fsync=off: the probe arms (lost-suffix rule),
+    # but replay already restored the exact head — quorum just confirms
+    assert core2.probing
+    _complete_probe(core2)
+    assert not core2.mint_blocked()
+
+
+def test_clean_close_receipt_skips_the_probe_on_empty_wal(tmp_path):
+    keys, parts = _participants(3)
+    wal_dir = str(tmp_path / "wal")
+    core = _make_core(0, keys, parts,
+                      WriteAheadLog(wal_dir, fsync="off"))
+    core.now_ns = iter(range(10**6, 10**7, 1000)).__next__
+    _complete_probe(core)
+    core.init()
+    core.wal.checkpointed(core.seq, core.head)      # empty log + receipt
+    core.wal.close(core.seq, core.head)
+
+    wal2 = WriteAheadLog(wal_dir, fsync="off")
+    assert not wal2.recovered_events and not wal2.is_fresh
+    # fresh engine + empty-but-receipted WAL: minting stays blocked at
+    # the receipt floor until gossip restores the published chain
+    core2 = _make_core(0, keys, parts, wal2)
+    assert not core2.probing
+    assert core2.min_next_seq == 1 and core2.mint_blocked()
+
+
+def test_load_checkpoint_tolerant_degrades_instead_of_crashing(tmp_path):
+    from babble_tpu.store import (
+        load_checkpoint_tolerant,
+        save_checkpoint,
+    )
+
+    keys, parts = _participants(3)
+    core = _make_core(0, keys, parts, None)
+    core.now_ns = iter(range(10**6, 10**7, 1000)).__next__
+    core.init()
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(core.hg, ckpt)
+    engine, err = load_checkpoint_tolerant(ckpt)
+    assert engine is not None and err is None
+
+    meta = os.path.join(ckpt, "meta.msgpack")
+    with open(meta, "r+b") as f:
+        f.truncate(os.path.getsize(meta) // 2)
+    engine, err = load_checkpoint_tolerant(ckpt)
+    assert engine is None and err
+
+    engine, err = load_checkpoint_tolerant(str(tmp_path / "nowhere"))
+    assert engine is None and err
+
+
+def test_truncation_counter_includes_discarded_later_segments(tmp_path):
+    """A corruption point discards every later segment; the counter
+    must reflect the records actually lost, not report 1 for a
+    hundred-record loss."""
+    key = generate_key()
+    evs = _chain(key, 12)
+    wal_dir = str(tmp_path / "w")
+    wal = WriteAheadLog(wal_dir, fsync="off", segment_bytes=256)
+    for ev in evs:
+        wal.append(ev)
+    wal.abort()
+    segs = sorted(f for f in os.listdir(wal_dir) if f.endswith(".wal")
+                  and os.path.getsize(os.path.join(wal_dir, f)) > 0)
+    assert len(segs) >= 3
+    first = os.path.join(wal_dir, segs[0])
+    with open(first, "r+b") as f:       # corrupt the FIRST segment
+        f.seek(os.path.getsize(first) - 5)
+        b = f.read(1)
+        f.seek(os.path.getsize(first) - 5)
+        f.write(bytes([b[0] ^ 0x7F]))
+
+    reg = Registry()
+    wal2 = WriteAheadLog(wal_dir, fsync="off", registry=reg)
+    lost = len(evs) - len(wal2.recovered_events)
+    # 1 corruption point; the other lost records were whole and are
+    # counted from the discarded later segments
+    assert wal2.truncated_records == lost
+    assert reg.get("babble_wal_truncated_records_total").value == lost
+    assert lost > 1
+
+
+def test_wal_orphan_self_event_unwedges_after_gossip(tmp_path):
+    """A fsynced-but-never-gossiped self record whose parents were lost
+    with the checkpoint pins the mint floor; once gossip restores the
+    ancestry, the SAME signed event re-inserts, head/seq adopt it, and
+    minting resumes — the node must not stay mute forever."""
+    keys, parts = _participants(2)
+    wal_dir = str(tmp_path / "wal")
+    a = _make_core(0, keys, parts,
+                   WriteAheadLog(wal_dir, fsync="off"))
+    b = _make_core(1, keys, parts, None)
+    clk = iter(range(10**6, 10**7, 1000))
+    a.now_ns = b.now_ns = clk.__next__
+    _complete_probe(a)
+    a.init()
+    b.init()
+    # a merges b's root (a's seq-1 event references b's chain), then
+    # mints one more; the WAL holds all of it
+    wire = b.to_wire(b.diff(a.known()))
+    assert a.sync(b.head, wire, [b"tx-1"]) is True
+    assert a.add_self_event([b"tx-2"])
+    head, seq = a.head, a.seq
+    # b learns a's chain (the "published" part: peers hold it)
+    assert b.sync(a.head, a.to_wire(a.diff(b.known())), []) is True
+    a.wal.abort()
+
+    # simulate "checkpoint rotted away": restart on a FRESH engine but
+    # keep only the WAL TAIL (drop a's root + b's root records), so the
+    # surviving self records cannot insert — orphans
+    wal2 = WriteAheadLog(wal_dir, fsync="off")
+    tail_only = wal2.recovered_events[2:]
+    wal2.recovered_events[:] = tail_only
+    a2 = _make_core(0, keys, parts, wal2)
+    assert a2.seq == -1                    # nothing insertable yet
+    assert a2.min_next_seq == seq + 1      # ...but the floor held
+    _complete_probe(a2)
+    assert a2.mint_blocked()               # floor unreachable so far
+
+    # gossip restores the ancestry (b re-serves everything it has,
+    # including a's published root) — the orphan retry must then adopt
+    # a's own logged tail and unblock minting
+    wire = b.to_wire(b.diff(a2.known()))
+    assert a2.sync(b.head, wire, [b"tx-3"]) is True
+    assert a2.seq >= seq + 1
+    assert not a2.mint_blocked()
+
+
+def test_segment_rotation_recovers_across_files(tmp_path):
+    key = generate_key()
+    evs = _chain(key, 12)
+    wal = WriteAheadLog(str(tmp_path / "w"), fsync="off",
+                        segment_bytes=256)   # force several rotations
+    for ev in evs:
+        wal.append(ev)
+    wal.abort()
+    segs = [f for f in os.listdir(str(tmp_path / "w"))
+            if f.endswith(".wal")]
+    assert len(segs) > 1
+    wal2 = WriteAheadLog(str(tmp_path / "w"), fsync="off")
+    assert [e.hex() for e in wal2.recovered_events] == \
+        [e.hex() for e in evs]
