@@ -46,6 +46,7 @@ from .engine import (
 from .cache import run_paths_cached
 from .graph import ProjectContext
 from .blocking import AsyncioBlockingCallRule
+from .codecloop import CodecOnLoopRule
 from .determinism import ConsensusNondeterminismRule
 from .guards import HeldGuardEscapeRule
 from .invariants import DrainBeforeValidateRule, FalsyOrFallbackRule
@@ -64,6 +65,7 @@ ALL_RULES = [
     JitUnhashableStaticRule(),
     AwaitStateRaceRule(),
     AsyncioBlockingCallRule(),
+    CodecOnLoopRule(),
     ChaosUnseededRandomRule(),
     ConsensusNondeterminismRule(),
     HeldGuardEscapeRule(),
@@ -91,6 +93,7 @@ __all__ = [
     "run_paths_cached",
     "AsyncioBlockingCallRule",
     "AwaitStateRaceRule",
+    "CodecOnLoopRule",
     "ChaosUnseededRandomRule",
     "ConsensusNondeterminismRule",
     "DrainBeforeValidateRule",

@@ -28,7 +28,7 @@ import asyncio
 from collections import deque
 from typing import Dict, Optional
 
-from ..net.commands import SyncRequest, SyncResponse
+from ..net.commands import PushRequest, SyncRequest, SyncResponse
 from ..net.transport import RPC, Transport, TransportError
 from ..obs import Registry
 from .injector import FAULT_KINDS, FaultInjector
@@ -105,32 +105,42 @@ class FaultyTransport(Transport):
     async def sync(self, target, req, timeout=None):
         if self._closed:
             raise TransportError("transport closed")
-        dst = self.addr_index.get(target)
-        if dst is not None and dst != self.node_id:
-            inj = self.injector
-            src = self.node_id
-            if inj.link_blocked(src, dst):
-                inj.record("partition", src, dst)
-                self._count("partition")
-                raise TransportError(f"chaos: partitioned from {target}")
-            act = inj.outbound(src, dst)
-            if act.drop:
-                self._count("drop")
-                raise TransportError(f"chaos: dropped sync to {target}")
-            if act.delay_s > 0:
-                self._count("delay")
-                await asyncio.sleep(act.delay_s)
-            if act.duplicate:
-                self._count("duplicate")
-                t = asyncio.ensure_future(
-                    self._shadow_send(target, req, timeout)
-                )
-                self._bg.add(t)
-                t.add_done_callback(self._bg.discard)
-            if act.reorder_s > 0:
-                self._count("reorder")
-                await asyncio.sleep(act.reorder_s)
+        await self._outbound_gate(target, req, timeout)
         return await self.inner.sync(target, req, timeout)
+
+    async def _outbound_gate(self, target, req, timeout) -> None:
+        """One per-link fault decision for an outbound gossip-class
+        message (sync AND push — the pipelined path's speculative
+        shipments take the same drop/delay/duplicate/reorder draws from
+        the same per-link RNG stream, so wrapping the multiplexed
+        transport changes nothing about the stream contract: the k-th
+        attempt on a link draws the k-th fault, whatever the verb)."""
+        dst = self.addr_index.get(target)
+        if dst is None or dst == self.node_id:
+            return
+        inj = self.injector
+        src = self.node_id
+        if inj.link_blocked(src, dst):
+            inj.record("partition", src, dst)
+            self._count("partition")
+            raise TransportError(f"chaos: partitioned from {target}")
+        act = inj.outbound(src, dst)
+        if act.drop:
+            self._count("drop")
+            raise TransportError(f"chaos: dropped sync to {target}")
+        if act.delay_s > 0:
+            self._count("delay")
+            await asyncio.sleep(act.delay_s)
+        if act.duplicate:
+            self._count("duplicate")
+            t = asyncio.ensure_future(
+                self._shadow_send(target, req, timeout)
+            )
+            self._bg.add(t)
+            t.add_done_callback(self._bg.discard)
+        if act.reorder_s > 0:
+            self._count("reorder")
+            await asyncio.sleep(act.reorder_s)
 
     async def _shadow_send(self, target, req, timeout) -> None:
         """The duplicate copy: delivered for real, response discarded.
@@ -144,10 +154,17 @@ class FaultyTransport(Transport):
             pass
 
     async def request(self, target, req, timeout=None):
-        """Verb-tagged RPCs (fast-forward fetches) honor partitions —
-        a snapshot must not cross a split brain — but skip the
-        link-noise faults: one logical catch-up is modeled as one
-        decision, on the sync path that triggered it."""
+        """Verb-tagged RPCs.  Pushes are gossip-class: they take the
+        full per-link fault gate exactly like syncs (same RNG stream).
+        Fast-forward fetches honor partitions — a snapshot must not
+        cross a split brain — but skip the link-noise faults: one
+        logical catch-up is modeled as one decision, on the sync path
+        that triggered it."""
+        if self._closed:
+            raise TransportError("transport closed")
+        if isinstance(req, (SyncRequest, PushRequest)):
+            await self._outbound_gate(target, req, timeout)
+            return await self.inner.request(target, req, timeout)
         dst = self.addr_index.get(target)
         if dst is not None and dst != self.node_id \
                 and self.injector.link_blocked(self.node_id, dst):

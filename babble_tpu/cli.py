@@ -153,6 +153,13 @@ async def _run_node(args) -> int:
         tcp_timeout=args.tcp_timeout / 1000.0,
         cache_size=args.cache_size,
         consensus_interval=args.consensus_interval / 1000.0,
+        pipeline=not getattr(args, "no_pipeline", False),
+        gossip_fanout=getattr(args, "gossip_fanout", 1),
+        gossip_inflight=getattr(args, "gossip_inflight", 4),
+        gossip_eager=not getattr(args, "no_eager_gossip", False),
+        coalesce_max=getattr(args, "coalesce_max", 1024),
+        coalesce_latency=getattr(args, "coalesce_latency", 50) / 1000.0,
+        mint_backpressure=getattr(args, "mint_backpressure", 0) or None,
         seq_window=args.seq_window or None,
         byzantine=args.byzantine,
         fork_k=args.fork_k,
@@ -186,8 +193,12 @@ async def _run_node(args) -> int:
     if args.no_client:
         proxy = InmemAppProxy()
     else:
-        proxy = SocketAppProxy(args.client_addr, args.proxy_addr,
-                               timeout=conf.tcp_timeout)
+        proxy = SocketAppProxy(
+            args.client_addr, args.proxy_addr,
+            timeout=conf.tcp_timeout,
+            submit_per_client=getattr(args, "submit_per_client", 1024),
+            submit_total=getattr(args, "submit_total", 8192),
+        )
         await proxy.start()
 
     node = Node(conf, key, peers, transport, proxy, engine=engine)
@@ -394,6 +405,17 @@ def cmd_testnet(args) -> int:
                 return 0
             time.sleep(args.interval)
     if args.testnet_cmd == "bombard":
+        if getattr(args, "clients", 1) > 1:
+            # many-client harness: per-connection admission identities,
+            # structured-overloaded backoff, shed/error accounting
+            counts = asyncio.run(tn.bombard_many(
+                args.n, clients=args.clients, rate=args.rate,
+                duration=args.duration, ports=ports,
+                batch=getattr(args, "batch", 1)))
+            print(f"submitted {counts['sent']} transactions "
+                  f"({counts['shed']} shed, {counts['errors']} errors, "
+                  f"{counts['clients']} clients)")
+            return 0
         sent = asyncio.run(
             tn.bombard(args.n, args.rate, args.duration, ports))
         print(f"submitted {sent} transactions")
@@ -604,6 +626,28 @@ def main(argv=None) -> int:
     rn.add_argument("--max_pool", type=int, default=2)
     rn.add_argument("--tcp_timeout", type=int, default=1000, help="ms")
     rn.add_argument("--cache_size", type=int, default=500)
+    rn.add_argument("--no_pipeline", action="store_true",
+                    help="disable pipelined gossip (speculative push); "
+                         "restores the lockstep pull exchange")
+    rn.add_argument("--gossip_fanout", type=int, default=1,
+                    help="peers gossiped per heartbeat tick")
+    rn.add_argument("--gossip_inflight", type=int, default=4,
+                    help="max concurrent outbound gossip exchanges")
+    rn.add_argument("--no_eager_gossip", action="store_true",
+                    help="don't launch the next gossip immediately when "
+                         "one finishes with txs pooled")
+    rn.add_argument("--coalesce_max", type=int, default=1024,
+                    help="max client txs coalesced into one event")
+    rn.add_argument("--coalesce_latency", type=int, default=50,
+                    help="ms a pooled tx may wait before a self-parent "
+                         "event is minted for it")
+    rn.add_argument("--mint_backpressure", type=int, default=0,
+                    help="pause deadline mints while undetermined "
+                         "backlog exceeds this (0 = cache_size/4)")
+    rn.add_argument("--submit_per_client", type=int, default=1024,
+                    help="admission control: per-client submit queue cap")
+    rn.add_argument("--submit_total", type=int, default=8192,
+                    help="admission control: total submit queue cap")
     rn.add_argument("--consensus_interval", type=int, default=0,
                     help="ms between consensus pipeline runs (0 = every sync)")
     rn.add_argument("--byzantine", action="store_true",
@@ -693,6 +737,13 @@ def main(argv=None) -> int:
         if name == "bombard":
             sp.add_argument("--rate", type=float, default=50.0, help="tx/s")
             sp.add_argument("--duration", type=float, default=10.0)
+            sp.add_argument("--clients", type=int, default=1,
+                            help=">1 uses the many-client harness "
+                                 "(per-connection admission identities, "
+                                 "overloaded-aware backoff)")
+            sp.add_argument("--batch", type=int, default=1,
+                            help="txs per Babble.SubmitTxBatch call "
+                                 "(many-client harness only)")
         sp.set_defaults(fn=cmd_testnet)
 
     flp = sub.add_parser("fleet", help="multi-host fleet ops "

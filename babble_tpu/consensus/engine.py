@@ -427,8 +427,8 @@ class TpuHashgraph:
     def to_wire(self, event: Event) -> WireEvent:
         return self.dag.to_wire(event)
 
-    def read_wire_info(self, wevent: WireEvent) -> Event:
-        return self.dag.read_wire_info(wevent)
+    def read_wire_info(self, wevent: WireEvent, overlay=None) -> Event:
+        return self.dag.read_wire_info(wevent, overlay)
 
     # ------------------------------------------------------------------
     # predicate surface (host queries against device arrays; test + runtime)

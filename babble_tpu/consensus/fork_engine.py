@@ -102,7 +102,7 @@ class ForkHashgraph:
         if self.verify_signatures:
             if event.creator not in self.participants:
                 raise ValueError("creator is not a participant")
-            if not event.verify():
+            if not event.chain_verified and not event.verify():
                 raise ValueError("bad event signature")
         self.dag.insert(event)
         self._dirty = True
@@ -176,7 +176,9 @@ class ForkHashgraph:
         # the compact (creatorID, index) form is ambiguous under forks
         return FullWireEvent.from_event(event)
 
-    def read_wire_info(self, w: FullWireEvent) -> Event:
+    def read_wire_info(self, w: FullWireEvent, overlay=None) -> Event:
+        # FullWireEvents carry parents by hash — self-contained, no
+        # batch overlay needed (accepted for interface uniformity)
         return w.to_event()
 
     # ------------------------------------------------------------------

@@ -27,7 +27,7 @@ never needs an evicted parent object.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -83,7 +83,8 @@ class HostDag:
         cid = self.participants.get(creator)
         if cid is None:
             raise InsertError(f"unknown participant {creator[:18]}…")
-        if self.verify_signatures and not event.verify():
+        if (self.verify_signatures and not event.chain_verified
+                and not event.verify()):
             raise InsertError("invalid signature")
 
         sp, op = event.self_parent, event.other_parent
@@ -228,19 +229,33 @@ class HostDag:
             sp_index, op_cid, op_index, self.participants[event.creator]
         )
 
-    def read_wire_info(self, wevent: WireEvent) -> Event:
+    def read_wire_info(self, wevent: WireEvent,
+                       overlay: Optional[dict] = None) -> Event:
+        """Materialize a compact wire event, resolving its (creator,
+        index) parent references.  ``overlay`` maps (cid, index) ->
+        hex for events of the SAME batch that are converted but not
+        yet inserted — it lets Core.sync convert a whole sync response
+        upfront (the signature-elision scan needs every hash before
+        the first insert) with identical resolution semantics to the
+        old convert-one-insert-one loop."""
         creator = self.reverse_participants[wevent.creator_id]
         cid = wevent.creator_id
+
+        def resolve(rcid: int, idx: int) -> str:
+            if overlay is not None:
+                h = overlay.get((rcid, idx))
+                if h is not None:
+                    return h
+            return self.events[self.chains[rcid][idx]].hex()
+
         self_parent = ""
         other_parent = ""
         if wevent.self_parent_index >= 0:
-            self_parent = self.events[
-                self.chains[cid][wevent.self_parent_index]
-            ].hex()
+            self_parent = resolve(cid, wevent.self_parent_index)
         if wevent.other_parent_index >= 0:
-            other_parent = self.events[
-                self.chains[wevent.other_parent_creator_id][wevent.other_parent_index]
-            ].hex()
+            other_parent = resolve(
+                wevent.other_parent_creator_id, wevent.other_parent_index
+            )
         body = EventBody(
             transactions=list(wevent.transactions),
             self_parent=self_parent,

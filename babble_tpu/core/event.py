@@ -76,6 +76,15 @@ class Event:
     round_received: Optional[int] = None
     consensus_timestamp: Optional[int] = None
 
+    #: signature-elision marker (ingress plane): set by Core.sync when a
+    #: LATER event of the same creator in the same batch — itself
+    #: signature-verified — names this event's full id (hash over
+    #: body+signature) as its self_parent.  The creator's signature on
+    #: the chain head transitively authenticates the whole contiguous
+    #: prefix, so per-event ECDSA re-verification is pure waste; insert
+    #: paths honor the flag (dag.insert / fork_engine.insert_event).
+    chain_verified: bool = field(default=False, repr=False)
+
     _hash: Optional[bytes] = field(default=None, repr=False)
     _hex: Optional[str] = field(default=None, repr=False)
     _creator_hex: Optional[str] = field(default=None, repr=False)

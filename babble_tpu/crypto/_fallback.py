@@ -114,6 +114,77 @@ def _mul(k: int, pt: _Point) -> _Point:
 
 
 # ----------------------------------------------------------------------
+# fixed-base comb tables (ingress plane, ISSUE 6)
+#
+# The live fleet signs one event per gossip exchange and verifies every
+# peer event it inserts; at fleet rates the double-and-add ladder above
+# (~256 doublings + ~128 additions per scalar mult) IS the hot path.
+# Both ECDSA mults have a fixed or nearly-fixed base — k*G always, and
+# u2*Q over the handful of fleet public keys — so a 4-bit fixed-window
+# comb (64 rows of the 15 odd multiples of 16^i * T) turns each mult
+# into <=64 additions, ~20x fewer group ops.  Tables build lazily (one
+# ~15 ms pass per point) and are cached: one for G, a bounded map for
+# recently-verified public keys.  Pure precomputation — the (r, s)
+# values are bit-identical to the ladder's, so deterministic-nonce
+# signatures (and therefore chaos fingerprints) are unchanged.  Like
+# the rest of this module it is NOT constant-time.
+
+class _CombTable:
+    __slots__ = ("rows",)
+
+    def __init__(self, pt: _Point):
+        base = _to_jac(pt)
+        rows = []
+        for _ in range(64):
+            row = [(0, 1, 0)]
+            acc = (0, 1, 0)
+            for _j in range(15):
+                acc = _jac_add(acc, base)
+                row.append(acc)
+            rows.append(row)
+            for _ in range(4):
+                base = _jac_double(base)
+        self.rows = rows
+
+    def mul_jac(self, k: int):
+        acc = (0, 1, 0)
+        i = 0
+        rows = self.rows
+        while k:
+            nib = k & 15
+            if nib:
+                acc = _jac_add(acc, rows[i][nib])
+            k >>= 4
+            i += 1
+        return acc
+
+
+_G_COMB: Optional[_CombTable] = None
+#: affine point -> comb table; bounded (fleet key sets are small — the
+#: clear-on-overflow keeps a hostile stream of unknown keys from
+#: growing memory, at worst re-paying the build cost)
+_POINT_COMBS: dict = {}
+_POINT_COMBS_MAX = 64
+
+
+def _g_comb() -> _CombTable:
+    global _G_COMB
+    if _G_COMB is None:
+        _G_COMB = _CombTable((GX, GY))
+    return _G_COMB
+
+
+def _comb_for(pt: Tuple[int, int]) -> _CombTable:
+    tbl = _POINT_COMBS.get(pt)
+    if tbl is None:
+        if len(_POINT_COMBS) >= _POINT_COMBS_MAX:
+            _POINT_COMBS.clear()
+        tbl = _CombTable(pt)
+        _POINT_COMBS[pt] = tbl
+    return tbl
+
+
+# ----------------------------------------------------------------------
 # key objects (duck-typed stand-ins for the hazmat key classes as used
 # by keys.py — only the operations keys.py routes here)
 
@@ -189,7 +260,7 @@ def sign(private: FallbackPrivateKey, digest: bytes) -> Tuple[int, int]:
     z = int.from_bytes(digest, "big")
     for counter in itertools.count():
         k = _det_nonce(private.d, digest, counter)
-        pt = _mul(k, (GX, GY))
+        pt = _from_jac(_g_comb().mul_jac(k))
         r = pt[0] % N
         if r == 0:
             continue
@@ -206,9 +277,12 @@ def verify(public: FallbackPublicKey, digest: bytes, r: int, s: int) -> bool:
         return False
     z = int.from_bytes(digest, "big")
     w = pow(s, -1, N)
+    # comb-table evaluation for both mults: u1*G off the shared G table,
+    # u2*Q off the per-key cache (fleet key sets are tiny, so after the
+    # first verify per key this is ~64+64 additions total)
     pt = _jac_add(
-        _to_jac(_mul(z * w % N, (GX, GY))),
-        _to_jac(_mul(r * w % N, public.point)),
+        _g_comb().mul_jac(z * w % N),
+        _comb_for(public.point).mul_jac(r * w % N),
     )
     aff = _from_jac(pt)
     if aff is None:

@@ -147,10 +147,26 @@ def pub_hex(public: ec.EllipticCurvePublicKey) -> str:
     return "0x" + pub_bytes(public).hex().upper()
 
 
+#: SEC1 bytes -> decoded key.  Event.verify decodes the creator key per
+#: event; a fleet has a handful of keys, so the decode (+ on-curve
+#: check) is pure waste past the first hit.  Bounded: a hostile stream
+#: of unknown keys clears the map instead of growing it.
+_PUB_CACHE: dict = {}
+_PUB_CACHE_MAX = 256
+
+
 def from_pub_bytes(data: bytes) -> ec.EllipticCurvePublicKey:
-    if not _HAVE_CRYPTO:
-        return _fb.FallbackPublicKey.from_sec1(data)
-    return ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, data)
+    key = bytes(data)
+    pub = _PUB_CACHE.get(key)
+    if pub is None:
+        if not _HAVE_CRYPTO:
+            pub = _fb.FallbackPublicKey.from_sec1(key)
+        else:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(_CURVE, key)
+        if len(_PUB_CACHE) >= _PUB_CACHE_MAX:
+            _PUB_CACHE.clear()
+        _PUB_CACHE[key] = pub
+    return pub
 
 
 def pub_hex_to_bytes(hex_id: str) -> bytes:

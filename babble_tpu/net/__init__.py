@@ -9,13 +9,15 @@ The wire format is msgpack frames (length-prefixed), not Go gob — only the
 information content matches the reference.
 """
 
-from .commands import SyncRequest, SyncResponse
+from .commands import PushRequest, PushResponse, SyncRequest, SyncResponse
 from .peers import Peer, JSONPeers, StaticPeers, canonical_ids, exclude_peer
 from .transport import RPC, Transport
 from .inmem_transport import InmemTransport, InmemNetwork
 from .tcp_transport import TCPTransport
 
 __all__ = [
+    "PushRequest",
+    "PushResponse",
     "SyncRequest",
     "SyncResponse",
     "Peer",

@@ -1,8 +1,24 @@
-"""The single RPC verb: sync (reference net/commands.go:20-29).
+"""RPC verbs: sync, fast-forward, push (reference net/commands.go:20-29).
 
 SyncRequest carries the requester's Known map (participant id -> event
 count, the gossip vector clock); SyncResponse returns the responder's head
-plus the wire events the requester lacks.
+plus the wire events the requester lacks — and, since the ingress-plane
+PR, the responder's OWN Known map, which seeds the requester's
+speculative-push state (see PushRequest).
+
+PushRequest is the pipelined half of gossip: instead of the lockstep
+request/response exchange (ask for the peer's diff, wait a full RTT,
+then mint), a node speculatively ships the events it believes the peer
+lacks — keyed on the last Known map it saw from that peer — together
+with its own head and Known.  The receiver inserts, mints a merge event
+carrying its pooled transactions, and acks with its updated Known; the
+classic Sync exchange remains the reconciliation path when the
+speculation was wrong or stale.
+
+Every command also reports ``approx_size()``: a cheap host-side size
+estimate (no encoding) that the transport's off-loop codec uses to
+decide whether to serialize on the event loop (small frames: the
+executor hop costs more than the encode) or on the codec thread.
 """
 
 from __future__ import annotations
@@ -15,6 +31,22 @@ import msgpack
 from ..core.event import FullWireEvent, WireEvent
 
 RPC_SYNC = 0
+
+
+def _unpack_events(events) -> List[WireEvent]:
+    # 9 fields = compact WireEvent; 8 = byzantine-mode FullWireEvent
+    return [
+        WireEvent.unpack(e) if len(e) == 9 else FullWireEvent.unpack(e)
+        for e in events
+    ]
+
+
+def _approx_events_size(events) -> int:
+    # per-event envelope (parent refs, ids, timestamp, signature ints)
+    # plus transaction payload bytes; len() only — never encodes
+    return sum(
+        96 + sum(len(t) for t in e.transactions) for e in events
+    )
 
 
 @dataclass
@@ -32,32 +64,40 @@ class SyncRequest:
         from_addr, known = msgpack.unpackb(data, raw=False)
         return cls(from_addr=from_addr, known={int(k): int(v) for k, v in known})
 
+    def approx_size(self) -> int:
+        return 64 + 16 * len(self.known)
+
 
 @dataclass
 class SyncResponse:
     from_addr: str
     head: str
     events: List[WireEvent] = field(default_factory=list)
+    #: the responder's own vector clock at response time — the
+    #: requester caches it as that peer's last-seen Known, keying the
+    #: next speculative push (pipelined gossip)
+    known: Dict[int, int] = field(default_factory=dict)
 
     def pack(self) -> bytes:
         return msgpack.packb(
-            [self.from_addr, self.head, [e.pack() for e in self.events]],
+            [self.from_addr, self.head, [e.pack() for e in self.events],
+             sorted(self.known.items())],
             use_bin_type=True,
         )
 
     @classmethod
     def unpack(cls, data: bytes) -> "SyncResponse":
-        from_addr, head, events = msgpack.unpackb(data, raw=False)
-        # 9 fields = compact WireEvent; 8 = byzantine-mode FullWireEvent
+        from_addr, head, events, known = msgpack.unpackb(data, raw=False)
         return cls(
             from_addr=from_addr,
             head=head,
-            events=[
-                WireEvent.unpack(e) if len(e) == 9
-                else FullWireEvent.unpack(e)
-                for e in events
-            ],
+            events=_unpack_events(events),
+            known={int(k): int(v) for k, v in known},
         )
+
+    def approx_size(self) -> int:
+        return (64 + 16 * len(self.known)
+                + _approx_events_size(self.events))
 
 
 RPC_FAST_FORWARD = 1
@@ -80,6 +120,9 @@ class FastForwardRequest:
         (from_addr,) = msgpack.unpackb(data, raw=False)
         return cls(from_addr=from_addr)
 
+    def approx_size(self) -> int:
+        return 64
+
 
 @dataclass
 class FastForwardResponse:
@@ -94,10 +137,81 @@ class FastForwardResponse:
         from_addr, snapshot = msgpack.unpackb(data, raw=False)
         return cls(from_addr=from_addr, snapshot=snapshot)
 
+    def approx_size(self) -> int:
+        return 64 + len(self.snapshot)
+
+
+RPC_PUSH = 2
+
+
+@dataclass
+class PushRequest:
+    """Speculative event shipment (pipelined gossip): events the sender
+    believes ``to``-peer lacks, keyed on the last Known map it saw from
+    that peer, plus the sender's own head + Known so the receiver can
+    mint a merge event and spot divergence without another RTT."""
+
+    from_addr: str
+    known: Dict[int, int]
+    head: str
+    events: List[WireEvent] = field(default_factory=list)
+
+    def pack(self) -> bytes:
+        return msgpack.packb(
+            [self.from_addr, sorted(self.known.items()), self.head,
+             [e.pack() for e in self.events]],
+            use_bin_type=True,
+        )
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "PushRequest":
+        from_addr, known, head, events = msgpack.unpackb(data, raw=False)
+        return cls(
+            from_addr=from_addr,
+            known={int(k): int(v) for k, v in known},
+            head=head,
+            events=_unpack_events(events),
+        )
+
+    def approx_size(self) -> int:
+        return (64 + 16 * len(self.known)
+                + _approx_events_size(self.events))
+
+
+@dataclass
+class PushResponse:
+    """Push ack: the receiver's post-insert Known map.  The sender
+    caches it (next push is keyed on it) and compares it against its
+    own clock — a creator the receiver knows MORE of triggers the
+    classic pull exchange as reconciliation."""
+
+    from_addr: str
+    known: Dict[int, int]
+
+    def pack(self) -> bytes:
+        return msgpack.packb(
+            [self.from_addr, sorted(self.known.items())], use_bin_type=True
+        )
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "PushResponse":
+        from_addr, known = msgpack.unpackb(data, raw=False)
+        return cls(from_addr=from_addr,
+                   known={int(k): int(v) for k, v in known})
+
+    def approx_size(self) -> int:
+        return 64 + 16 * len(self.known)
+
 
 SyncRequest.RTYPE = RPC_SYNC
 SyncRequest.RESPONSE_CLS = SyncResponse
 FastForwardRequest.RTYPE = RPC_FAST_FORWARD
 FastForwardRequest.RESPONSE_CLS = FastForwardResponse
+PushRequest.RTYPE = RPC_PUSH
+PushRequest.RESPONSE_CLS = PushResponse
 
-REQUEST_TYPES = {RPC_SYNC: SyncRequest, RPC_FAST_FORWARD: FastForwardRequest}
+REQUEST_TYPES = {
+    RPC_SYNC: SyncRequest,
+    RPC_FAST_FORWARD: FastForwardRequest,
+    RPC_PUSH: PushRequest,
+}

@@ -34,8 +34,47 @@ class Config:
     # of how long syncs take (reference node.go:127-133), so without a cap
     # a slow patch floods the fleet with queued sync tasks whose timeouts
     # then read as failures.  The reference never hits this (its per-sync
-    # work is microseconds); with a batched engine it matters.
+    # work is microseconds); with a batched engine it matters.  A
+    # heartbeat skipped because the cap is full increments
+    # babble_gossip_skipped_total — saturation is visible on /metrics,
+    # not inferred from a flat sync_rate.
     gossip_inflight: int = 4
+    # ---- ingress plane (pipelined gossip + coalescing) ----
+    # Pipelined sync: speculatively PUSH events to a peer keyed on the
+    # last Known map we saw from it (ack carries its updated clock),
+    # with the classic pull exchange as the reconciliation path — every
+    # pipeline_reconcile-th gossip to a peer, on any push failure, and
+    # whenever the ack shows the peer ahead of us.  False restores the
+    # reference's lockstep request/response gossip.
+    pipeline: bool = True
+    pipeline_reconcile: int = 8
+    # Peers gossiped per heartbeat tick (distinct targets, still under
+    # the gossip_inflight cap).  The multiplexed transport carries the
+    # concurrent exchanges on one connection per peer.
+    gossip_fanout: int = 1
+    # Eager gossip under load: when a gossip task finishes and client
+    # transactions are pooled, launch the next gossip immediately
+    # instead of waiting for the heartbeat deadline — the heartbeat
+    # stays the *idle* pace, the pipeline depth (gossip_inflight) the
+    # loaded one.
+    gossip_eager: bool = True
+    # Adaptive tx coalescing: a minted event carries at most
+    # coalesce_max pooled transactions (batch size adapts to backlog —
+    # the pool IS the batch, capped); a pooled tx waits at most
+    # coalesce_latency seconds before a self-parent event is minted for
+    # it even when no gossip completes (the latency bound; only active
+    # while the gossip loop runs heartbeats).
+    coalesce_max: int = 1024
+    coalesce_latency: float = 0.05
+    # Mint backpressure: deadline self-mints pause while the engine's
+    # undetermined backlog exceeds this (None = cache_size // 4).  The
+    # batch size is what adapts: with mints paused the pool keeps
+    # growing toward coalesce_max, so overload produces fewer, FULLER
+    # events instead of outrunning consensus until the window jams
+    # (observed live: creation past the consensus window wedges
+    # ordering at 0 ev/s).  Merge mints on gossip keep running — they
+    # are what advances rounds and drains the backlog.
+    mint_backpressure: int | None = None
     # Per-creator rolling-window length (TooLate beyond it).  None = use
     # cache_size, the reference's ParticipantEventsCache semantics; set it
     # smaller to keep the device window (and therefore the jit shapes)

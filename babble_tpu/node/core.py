@@ -19,6 +19,37 @@ from ..obs import Registry
 from ..wal import WriteAheadLog
 
 
+def _mark_chain_verified(events: List[Event]) -> None:
+    """Signature elision over contiguous self-parent chains.
+
+    For each creator, the batch (already topologically ordered, so a
+    creator's events appear oldest-first) is split into runs where each
+    event's ``self_parent`` is the previous event's full id and the
+    index is contiguous.  The newest event of a run >= 2 is verified
+    HERE, upfront; success marks the entire run ``chain_verified`` (the
+    insert paths then skip per-event ECDSA).  Soundness: an event's id
+    hashes body+signature, and the id is inside the successor's SIGNED
+    body — so the head signature authenticates every predecessor byte
+    transitively, and a fabricated prefix event would break the hash
+    chain it claims membership of.  A failed head verify marks nothing:
+    the per-event insert checks then reject exactly as before.  Runs of
+    one (idle fleets) keep the plain per-event verify."""
+    runs: Dict[str, List[Event]] = {}
+    for ev in events:
+        run = runs.setdefault(ev.creator, [])
+        if run and not (ev.self_parent == run[-1].hex()
+                        and ev.index == run[-1].index + 1):
+            if len(run) >= 2 and run[-1].verify():
+                for e in run:
+                    e.chain_verified = True
+            runs[ev.creator] = run = []
+        run.append(ev)
+    for run in runs.values():
+        if len(run) >= 2 and run[-1].verify():
+            for e in run:
+                e.chain_verified = True
+
+
 class Core:
     def __init__(
         self,
@@ -579,9 +610,28 @@ class Core:
         response, or a single spamming equivocator would permanently
         poison every future sync that includes its events.  Honest mode
         stays strict — there an insert error means a protocol violation
-        and the whole sync is rejected (reference core.go:139-146)."""
+        and the whole sync is rejected (reference core.go:139-146).
+
+        Signature elision (ingress plane): the batch is scanned for
+        contiguous self-parent chains per creator; one upfront ECDSA
+        verify of each chain's newest event transitively authenticates
+        the whole run (the signed body names the predecessor's full
+        body+signature hash), so under load per-event verify cost
+        divides by the batch depth instead of pacing the fleet."""
+        # convert the whole batch upfront (the elision scan needs every
+        # hash before the first insert); the overlay resolves compact
+        # parent references into the not-yet-inserted batch prefix with
+        # the same semantics the old convert-one-insert-one loop had
+        overlay: Dict[Tuple[int, int], str] = {}
+        events: List[Event] = []
         for w in wire_events:
-            ev = self.hg.read_wire_info(w)
+            ev = self.hg.read_wire_info(w, overlay)
+            creator_cid = self.participants.get(ev.creator)
+            if creator_cid is not None:
+                overlay[(creator_cid, ev.index)] = ev.hex()
+            events.append(ev)
+        _mark_chain_verified(events)
+        for ev in events:
             if ev.hex() in self.hg.dag.slot_of:
                 continue
             if self.byzantine:

@@ -1,7 +1,7 @@
 """Node: the gossip event loop (reference node/node.go:35-351).
 
 One asyncio task multiplexes, exactly like the reference's select loop:
-- inbound sync RPCs from the transport consumer,
+- inbound sync/push RPCs from the transport consumer,
 - a randomized heartbeat timer triggering outbound gossip,
 - app transactions from the proxy's submit queue (buffered in a pool until
   the next self-event),
@@ -10,6 +10,26 @@ One asyncio task multiplexes, exactly like the reference's select loop:
 
 Core access is serialized by an asyncio lock (the reference's coreLock);
 consensus itself stays single-threaded while the JAX kernels run batched.
+
+The ingress plane (ISSUE 6) reworked the live hot path around that loop:
+
+- **pipelined gossip** — each heartbeat speculatively PUSHES the events
+  a peer lacks, keyed on the last Known map seen from it (its pull
+  requests, push acks and sync responses all refresh the cache),
+  instead of the reference's lockstep ask-wait-apply exchange; the
+  classic pull sync stays as the reconciliation path (every
+  ``pipeline_reconcile``-th gossip, after any push failure, and
+  whenever an ack shows the peer ahead).  Inbound pushes mint a merge
+  event exactly like applied sync responses do, so event creation is no
+  longer bounded by one outbound RPC per heartbeat.
+- **greedy submit drain + adaptive coalescing** — one select wakeup
+  drains the whole submitted burst into the pool (the reference woke
+  once per tx, node.py:272,291 pre-PR), and a minted event carries up
+  to ``coalesce_max`` pooled txs; a pooled tx waits at most
+  ``coalesce_latency`` before a self-parent event is minted for it.
+- **saturation visibility** — a heartbeat that cannot launch gossip
+  because ``gossip_inflight`` is full increments
+  ``babble_gossip_skipped_total`` instead of passing silently.
 """
 
 from __future__ import annotations
@@ -26,12 +46,14 @@ from ..crypto.keys import KeyPair
 from ..net.commands import (
     FastForwardRequest,
     FastForwardResponse,
+    PushRequest,
+    PushResponse,
     SyncRequest,
     SyncResponse,
 )
 from ..net.peers import Peer, canonical_ids
 from ..net.transport import Transport, TransportError
-from ..obs import LoopLagProbe, Registry, SpanTracer
+from ..obs import SIZE_BUCKETS, LoopLagProbe, Registry, SpanTracer
 from .config import Config
 from .core import Core
 from .peer_selector import RandomPeerSelector
@@ -40,6 +62,30 @@ from .peer_selector import RandomPeerSelector
 #: children are pre-created so /metrics shows the full consensus-phase
 #: distribution from boot, not from first observation
 _CONSENSUS_PHASES = ("divide_rounds", "decide_fame", "find_order")
+
+#: bounds for one speculative push frame.  The diff is topologically
+#: sorted and parents precede children, so a PREFIX is ancestry-closed
+#: relative to the peer's advertised Known — the tail simply rides the
+#: next rounds.  Both a count cap AND a byte budget apply: coalesced
+#: events can carry a KB of transactions each, so an event-count cap
+#: alone could still assemble a frame past MAX_FRAME — which would
+#: fail the push (FrameTooLarge) on every retry after paying the full
+#: encode each time.  Deep catch-up belongs to pull/fast-forward.
+PUSH_MAX_EVENTS = 512
+PUSH_MAX_BYTES = 4 * 1024 * 1024
+
+
+def _push_prefix(diff: List[Event]) -> List[Event]:
+    """Ancestry-closed prefix of a topologically-sorted diff that fits
+    the push frame bounds (len()-based estimate, never encodes)."""
+    if len(diff) > PUSH_MAX_EVENTS:
+        diff = diff[:PUSH_MAX_EVENTS]
+    budget = PUSH_MAX_BYTES
+    for i, ev in enumerate(diff):
+        budget -= 96 + sum(len(t) for t in ev.body.transactions)
+        if budget < 0:
+            return diff[: max(i, 1)]
+    return diff
 
 
 class Node:
@@ -69,6 +115,11 @@ class Node:
         self.participants = participants
         local_addr = transport.local_addr()
         own_id = participants[key.pub_hex]
+        #: gossip address -> participant id (the push reconciliation
+        #: check needs to know which Known column is the peer's own)
+        self._addr_cid = {
+            p.net_addr: participants[p.pub_key_hex] for p in peers
+        }
 
         # durability plane: the WAL constructor performs recovery
         # (scan + truncate-at-first-bad-record); Core replays the
@@ -104,10 +155,28 @@ class Node:
         # makes live chaos pacing replayable per identity
         self._pacing_rng = random.Random(f"heartbeat:{own_id}")
         self.transaction_pool: List[bytes] = []
+        #: monotonic time the OLDEST pooled tx entered an empty pool —
+        #: the coalesce latency bound is measured from here
+        self._pool_since: Optional[float] = None
+        #: pipelined gossip: last Known map seen from each peer (their
+        #: pull requests, push acks and sync responses all refresh it);
+        #: the next speculative push to that peer is keyed on it
+        self._peer_known: Dict[str, Dict[int, int]] = {}
+        #: per-peer gossip counter driving the periodic pull
+        #: reconciliation cadence (conf.pipeline_reconcile)
+        self._gossip_count: Dict[str, int] = {}
+        #: peers with an exchange in flight: a second concurrent push to
+        #: the same peer would be keyed on the SAME stale Known map and
+        #: re-ship the same events — pure duplicate decode/insert work
+        #: at the receiver — so the scheduler picks another peer instead
+        self._busy_peers: set = set()
 
         self._shutdown = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
         self._gossip_tasks: set = set()
+        #: short-lived helper tasks (post-push consensus runs) — kept so
+        #: shutdown can cancel them and GC can't reap them mid-flight
+        self._aux_tasks: set = set()
         # Commit batches flow through a queue drained by one committer task
         # (the reference's commitCh, node.go:137-141): batches are enqueued
         # under the core lock, so the app always sees consensus order even
@@ -155,6 +224,33 @@ class Node:
             labelnames=("phase",))
         for phase in _CONSENSUS_PHASES:
             self._m_phase_seconds.labels(phase)
+        self._m_gossip_skipped = m.counter(
+            "babble_gossip_skipped_total",
+            "heartbeats that launched no gossip because gossip_inflight "
+            "was saturated")
+        self._m_push_total = m.counter(
+            "babble_push_total", "speculative event pushes attempted")
+        self._m_push_errors = m.counter(
+            "babble_push_errors_total",
+            "speculative pushes that failed (reconciled via pull)")
+        self._m_push_rtt = m.histogram(
+            "babble_push_rtt_seconds",
+            "push RPC round-trip time (request sent to ack parsed)")
+        self._m_push_apply = m.histogram(
+            "babble_push_apply_seconds",
+            "insert+mint wall time per applied inbound push")
+        self._m_coalesce_txs = m.histogram(
+            "babble_coalesce_batch_txs",
+            "client transactions coalesced into one minted event",
+            buckets=SIZE_BUCKETS)
+        self._m_deadline_mints = m.counter(
+            "babble_coalesce_deadline_mints_total",
+            "self-parent events minted because a pooled tx hit the "
+            "coalesce_latency bound before any gossip carried it")
+        self._m_mint_backpressure = m.counter(
+            "babble_mint_backpressure_total",
+            "deadline mint passes skipped because the undetermined "
+            "backlog exceeded mint_backpressure")
         self._m_submitted_tx = m.counter(
             "babble_submitted_tx_total",
             "transactions accepted into the pool from the app")
@@ -185,6 +281,11 @@ class Node:
         instrument = getattr(transport, "instrument", None)
         if instrument is not None:
             instrument(m)
+        # admission-control series (queue depth, sheds, client count)
+        # land on the same page when the proxy fronts a real ingress
+        proxy_instrument = getattr(proxy, "instrument", None)
+        if proxy_instrument is not None:
+            proxy_instrument(m)
 
     # ------------------------------------------------------------------
     # registry-backed mirrors of the legacy counters/dict
@@ -236,13 +337,20 @@ class Node:
         Byzantine mode snapshots ForkDag host state (branch columns,
         seeds, window) — see store.checkpoint._build_fork_meta.
         A successful save prunes the WAL: the checkpoint now carries
-        everything the pruned records did."""
+        everything the pruned records did.  The serialize + fsync runs
+        in a worker thread (codec-on-loop discipline): a multi-MB
+        checkpoint built inline would stall every RPC and heartbeat for
+        its duration — the async lock still serializes core access."""
         from ..store import save_checkpoint
 
+        loop = asyncio.get_running_loop()
         async with self.core_lock:
-            save_checkpoint(self.core.hg, path)
-            if self.core.wal is not None:
-                self.core.wal.checkpointed(self.core.seq, self.core.head)
+            def work():
+                save_checkpoint(self.core.hg, path)
+                if self.core.wal is not None:
+                    self.core.wal.checkpointed(self.core.seq, self.core.head)
+
+            await loop.run_in_executor(None, work)
 
     async def run(self, gossip: bool = True) -> None:
         """The select loop (reference node.go:119-147)."""
@@ -267,14 +375,37 @@ class Node:
             _time.monotonic() + self._random_timeout() if gossip else None
         )
 
+        # The pool is bounded at one full mint burst: while it is at
+        # capacity the loop does NOT drain the submit queue, so
+        # backpressure propagates front-door-ward — the admission queue
+        # fills and SHEDS (structured `overloaded`) instead of the node
+        # buffering an unbounded backlog it cannot mint (the mint
+        # backpressure gate pauses minting while consensus is behind)
+        pool_cap = max(self.conf.coalesce_max, 1) * self.MINT_BURST_MAX
+
         while not self._shutdown.is_set():
             get_rpc = asyncio.ensure_future(consumer.get())
-            get_tx = asyncio.ensure_future(self.proxy.submit_queue.get())
+            get_tx = (
+                asyncio.ensure_future(self.proxy.submit_queue.get())
+                if len(self.transaction_pool) < pool_cap else None
+            )
             shutdown = asyncio.ensure_future(self._shutdown.wait())
-            waiters = [get_rpc, get_tx, shutdown]
+            waiters = [w for w in (get_rpc, get_tx, shutdown)
+                       if w is not None]
+            # the wakeup serves two deadlines: the heartbeat, and the
+            # coalesce latency bound of the oldest pooled tx (gossip
+            # mode only — the scenario runner's heartbeat-less loops
+            # must stay wall-clock-free for determinism)
+            eff_deadline = deadline
+            if gossip and self._pool_since is not None:
+                mint_at = self._pool_since + self.conf.coalesce_latency
+                eff_deadline = (
+                    mint_at if eff_deadline is None
+                    else min(eff_deadline, mint_at)
+                )
             timeout = (
-                None if deadline is None
-                else max(0.0, deadline - _time.monotonic())
+                None if eff_deadline is None
+                else max(0.0, eff_deadline - _time.monotonic())
             )
             done, pending = await asyncio.wait(
                 waiters,
@@ -287,18 +418,33 @@ class Node:
                 break
             if get_rpc in done:
                 await self._process_rpc(get_rpc.result())
-            if get_tx in done:
-                self.transaction_pool.append(get_tx.result())
-                self._m_submitted_tx.inc()
+            if get_tx is not None and get_tx in done:
+                # greedy burst drain: one wakeup pools the whole burst
+                # instead of one tx per select iteration (the pre-PR
+                # loop re-entered asyncio.wait per submitted tx) — up
+                # to the pool cap, past which admission must shed
+                self._note_tx(get_tx.result())
+                q = self.proxy.submit_queue
+                while len(self.transaction_pool) < pool_cap:
+                    try:
+                        self._note_tx(q.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+            if gossip and self._pool_since is not None \
+                    and _time.monotonic() >= (
+                        self._pool_since + self.conf.coalesce_latency):
+                # latency bound: no gossip carried the pooled txs in
+                # time (unreachable peers, saturated pipeline) — mint a
+                # self-parent event so the batch stops aging
+                await self._mint_pooled()
             if gossip and _time.monotonic() >= deadline:
-                # backpressure: never queue more in-flight syncs than the
-                # fleet can serve (Config.gossip_inflight)
-                if len(self._gossip_tasks) < self.conf.gossip_inflight:
-                    peer = self.peer_selector.next()
-                    if peer is not None:
-                        t = asyncio.create_task(self._gossip(peer.net_addr))
-                        self._gossip_tasks.add(t)
-                        t.add_done_callback(self._gossip_tasks.discard)
+                # backpressure: never queue more in-flight syncs than
+                # the fleet can serve (Config.gossip_inflight); a
+                # heartbeat fans out to gossip_fanout distinct peers on
+                # the multiplexed transport
+                for _ in range(max(1, self.conf.gossip_fanout)):
+                    if not self._launch_gossip():
+                        break
                 # ABSOLUTE pacing: advance from the previous deadline, not
                 # from now — rebasing to monotonic() leaks the loop's
                 # servicing time into every cycle (~3% of the heartbeat in
@@ -316,10 +462,226 @@ class Node:
         self._tasks.append(t)
         return t
 
+    # ------------------------------------------------------------------
+    # ingress: submit pooling + coalescing
+
+    def _note_tx(self, tx: bytes) -> None:
+        if not self.transaction_pool:
+            self._pool_since = time.monotonic()
+        self.transaction_pool.append(tx)
+        self._m_submitted_tx.inc()
+
+    def _take_payload(self) -> List[bytes]:
+        """Pop up to ``coalesce_max`` pooled txs for the next minted
+        event (caller holds the core lock).  The pool IS the adaptive
+        batch: small under light load, up to the cap under backlog."""
+        take = self.transaction_pool[: self.conf.coalesce_max]
+        if take:
+            del self.transaction_pool[: len(take)]
+            # the remaining backlog gets a fresh latency window — it
+            # was not starved, the cap simply split the burst
+            self._pool_since = (
+                time.monotonic() if self.transaction_pool else None
+            )
+        return take
+
+    def _requeue(self, payload: List[bytes]) -> None:
+        """A mint never happened (recovery gate, byzantine merge-skip,
+        insert failure): the payload goes back to the FRONT of the pool
+        so client ordering is preserved for the retry."""
+        if not payload:
+            return
+        self.transaction_pool[:0] = payload
+        if self._pool_since is None:
+            self._pool_since = time.monotonic()
+
+    #: self events minted per _mint_pooled call: bounds the core-lock
+    #: hold (each mint is one ECDSA sign) while letting a deep backlog
+    #: drain at thousands of events/s across deadline ticks
+    MINT_BURST_MAX = 64
+
+    async def _mint_pooled(self) -> None:
+        """The coalesce latency bound: mint self-parent events for the
+        pooled txs when no gossip carried them in time.  A backlog
+        deeper than one batch mints a CHAIN of events (each carrying up
+        to coalesce_max txs) in one executor call — receivers verify
+        the chain head once (signature elision), so event creation is
+        not bounded by the gossip exchange rate."""
+        loop = asyncio.get_running_loop()
+        async with self.core_lock:
+            if not self.transaction_pool:
+                return
+            # engine backpressure: creating events faster than consensus
+            # decides them eventually jams the window and ordering stops
+            # dead — pause deadline mints (the pool keeps coalescing, so
+            # the NEXT mint is fuller) until the backlog drains.  Merge
+            # mints on gossip keep running; they advance rounds.
+            limit = self.conf.mint_backpressure
+            if limit is None:
+                limit = max((self.conf.cache_size or 4096) // 4, 64)
+            undet = self.core.stats_snapshot().get(
+                "undetermined_events", 0)   # host mirror: no device sync
+            if undet > limit:
+                self._m_mint_backpressure.inc()
+                self._pool_since = time.monotonic()   # re-arm, don't spin
+                return
+            batches: List[List[bytes]] = []
+            while self.transaction_pool and len(batches) < self.MINT_BURST_MAX:
+                batches.append(self._take_payload())
+            done = {"n": 0}
+
+            def work():
+                for b in batches:
+                    if not self.core.add_self_event(b):
+                        return
+                    done["n"] += 1
+
+            try:
+                await loop.run_in_executor(None, work)
+            finally:
+                # mint_blocked (recovery gate) or an exception: the
+                # unminted tail goes back to the pool front, in order
+                for b in reversed(batches[done["n"]:]):
+                    self._requeue(b)
+            for b in batches[: done["n"]]:
+                self._m_coalesce_txs.observe(len(b))
+            if done["n"]:
+                self._m_deadline_mints.inc(done["n"])
+                if self.conf.consensus_interval > 0:
+                    self._consensus_dirty = True
+
+    # ------------------------------------------------------------------
+    # ingress: gossip scheduling
+
+    def _launch_gossip(self, eager: bool = False) -> bool:
+        """Start one gossip task if the in-flight cap allows.  Heartbeat
+        launches count a skip against babble_gossip_skipped_total when
+        blocked; eager refills don't (they are opportunistic)."""
+        if len(self._gossip_tasks) >= self.conf.gossip_inflight:
+            if not eager:
+                self._m_gossip_skipped.inc()
+            return False
+        peer = None
+        for _ in range(max(len(self.peer_selector.peers()), 1)):
+            cand = self.peer_selector.next()
+            if cand is None:
+                break
+            if cand.net_addr not in self._busy_peers:
+                peer = cand
+                break
+        if peer is None:
+            return False
+        self._busy_peers.add(peer.net_addr)
+        t = asyncio.create_task(self._gossip_step(peer.net_addr))
+        t._babble_peer = peer.net_addr
+        self._gossip_tasks.add(t)
+        t.add_done_callback(self._gossip_finished)
+        return True
+
+    def _gossip_finished(self, t: asyncio.Task) -> None:
+        self._gossip_tasks.discard(t)
+        self._busy_peers.discard(getattr(t, "_babble_peer", None))
+        # eager pipeline refill: while client txs are pooled, a finished
+        # PRODUCTIVE gossip immediately launches the next one instead of
+        # waiting out the heartbeat — the heartbeat is the idle pace,
+        # gossip_inflight the loaded pipeline depth.  Failed gossips
+        # don't refill (the heartbeat retries), so an unreachable fleet
+        # can't spin the loop.
+        if not self.conf.gossip_eager or self._shutdown.is_set():
+            return
+        if t.cancelled() or t.exception() is not None:
+            return
+        if t.result() is not True or not self.transaction_pool:
+            return
+        self._launch_gossip(eager=True)
+
+    async def _gossip_step(self, peer_addr: str) -> bool:
+        """One scheduled gossip to ``peer_addr``: speculative push when
+        we hold a cached Known for the peer, the classic pull exchange
+        for reconciliation (periodically, and on any push failure).
+        Returns True when an exchange was applied."""
+        count = self._gossip_count.get(peer_addr, 0) + 1
+        self._gossip_count[peer_addr] = count
+        peer_known = self._peer_known.get(peer_addr)
+        if not self.conf.pipeline or peer_known is None:
+            return await self._gossip(peer_addr)
+        # the transitive `_fast_forwarding` writes flagged on this call
+        # are the documented busy-guard inside _fast_forward itself
+        # (entry check + finally clear, no await between check and set)
+        # — the flag's intermediate visibility is its designed semantics
+        ok = await self._gossip_push(peer_addr, peer_known)  # babble-lint: disable=await-state-race
+        if not ok:
+            # wrong speculation (peer restarted, our cache stale): drop
+            # the cache so the next rounds re-seed through pull
+            self._peer_known.pop(peer_addr, None)
+            return await self._gossip(peer_addr)
+        if count % max(2, self.conf.pipeline_reconcile) == 0:
+            # periodic full exchange: pulls events pushes can't see
+            # (creators the peer learned of from others) and re-seeds
+            # the Known cache from an authoritative response
+            return await self._gossip(peer_addr)  # babble-lint: disable=await-state-race
+        return True
+
+    async def _gossip_push(
+        self, peer_addr: str, peer_known: Dict[int, int]
+    ) -> bool:
+        """Speculatively ship the events ``peer_addr`` lacks per its
+        last advertised Known.  The ack carries the peer's updated
+        clock; if it shows the peer AHEAD of us for any creator, the
+        pull exchange runs immediately as reconciliation."""
+        loop = asyncio.get_running_loop()
+        try:
+            with self.tracer.span("push", peer=peer_addr):
+                async with self.core_lock:
+                    def work():
+                        diff = _push_prefix(self.core.diff(peer_known))
+                        return (self.core.to_wire(diff), self.core.known(),
+                                self.core.head)
+
+                    wire, my_known, head = await loop.run_in_executor(
+                        None, work
+                    )
+                self._m_push_total.inc()
+                t0 = time.perf_counter()
+                resp = await self.transport.request(
+                    peer_addr,
+                    PushRequest(
+                        from_addr=self.transport.local_addr(),
+                        known=my_known, head=head, events=wire,
+                    ),
+                    timeout=self.conf.tcp_timeout,
+                )
+                self._m_push_rtt.observe(time.perf_counter() - t0)
+                self._peer_known[peer_addr] = dict(resp.known)
+                self.peer_selector.update_last(peer_addr)
+                # reconciliation trigger: the peer knows events of a
+                # THIRD creator (or of us) that we lack — pull now.
+                # The peer's OWN column is deliberately excluded: it is
+                # always ahead by the merge event it just minted for
+                # this very push, and that event reaches us on the
+                # peer's next push (it knows our Known from this
+                # request) — pulling for it doubled every exchange
+                peer_cid = self._addr_cid.get(peer_addr)
+                if any(v > my_known.get(cid, 0)
+                       for cid, v in resp.known.items()
+                       if cid != peer_cid):
+                    await self._gossip(peer_addr)
+            return True
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # push failures are part of the pipelined protocol (stale
+            # speculation reconciles via pull) — they get their own
+            # counter and never dent sync_rate
+            self._m_push_errors.inc()
+            self.logger.debug("push to %s failed: %s", peer_addr, e)
+            return False
+
     async def shutdown(self) -> None:
         self._shutdown.set()
         committer = [self._committer] if self._committer is not None else []
-        for t in list(self._gossip_tasks) + self._tasks + committer:
+        for t in (list(self._gossip_tasks) + list(self._aux_tasks)
+                  + self._tasks + committer):
             t.cancel()
             try:
                 await t
@@ -339,6 +701,8 @@ class Node:
         try:
             if isinstance(req, FastForwardRequest):
                 resp = await self._process_fast_forward_request(req)
+            elif isinstance(req, PushRequest):
+                resp = await self._process_push_request(req)
             else:
                 resp = await self._process_sync_request(req)
             rpc.respond(resp)
@@ -355,17 +719,75 @@ class Node:
         """Diff + wire conversion under the core lock (node.go:160-191).
         Runs in a worker thread so the event loop keeps serving submits
         and RPCs while the host index churns; the async lock still
-        serializes all core access."""
+        serializes all core access.  The requester's Known map seeds our
+        speculative-push cache for that peer, and our own Known rides
+        the response so the requester can seed ITS cache of us."""
+        self._peer_known[req.from_addr] = dict(req.known)
         loop = asyncio.get_running_loop()
         async with self.core_lock:
             def work():
                 diff = self.core.diff(req.known)
-                return self.core.to_wire(diff), self.core.head
+                return (self.core.to_wire(diff), self.core.head,
+                        self.core.known())
 
-            wire, head = await loop.run_in_executor(None, work)
+            wire, head, known = await loop.run_in_executor(None, work)
         return SyncResponse(
-            from_addr=self.transport.local_addr(), head=head, events=wire
+            from_addr=self.transport.local_addr(), head=head, events=wire,
+            known=known,
         )
+
+    async def _process_push_request(self, req: PushRequest) -> PushResponse:
+        """Apply a speculative push: insert the shipped events and mint
+        a merge event carrying our pooled transactions — the same apply
+        path as a pull response, so inbound pushes create events too
+        (event creation is no longer bounded by one outbound RPC per
+        heartbeat).  The ack returns our post-insert Known."""
+        loop = asyncio.get_running_loop()
+        async with self.core_lock:
+            payload = self._take_payload()
+            t0 = time.perf_counter()
+            try:
+                minted = await loop.run_in_executor(
+                    None, self.core.sync, req.head, req.events, payload
+                )
+                if minted is False:
+                    self._requeue(payload)
+            except BaseException:
+                # insert failure (our view genuinely lacked ancestry
+                # the sender assumed): the error frame tells the sender
+                # its speculation was stale; it reconciles via pull
+                self._requeue(payload)
+                raise
+            self._m_push_apply.observe(time.perf_counter() - t0)
+            self._m_gossip_events.inc(len(req.events))
+            if minted is not False and payload:
+                self._m_coalesce_txs.observe(len(payload))
+            known = self.core.known()
+            if self.conf.consensus_interval > 0:
+                self._consensus_dirty = True
+        if self.conf.consensus_interval <= 0:
+            # interval<=0 keeps consensus-after-every-sync semantics,
+            # but OFF the pusher's RPC window: the ack must not pay our
+            # pipeline latency (first-compile stalls measured in
+            # seconds), so the run happens in its own task — launched
+            # outside the lock block; it re-acquires the core lock on
+            # its own schedule
+            t = asyncio.create_task(self._consensus_after_push())
+            self._aux_tasks.add(t)
+            t.add_done_callback(self._aux_tasks.discard)
+        self._peer_known[req.from_addr] = dict(req.known)
+        return PushResponse(
+            from_addr=self.transport.local_addr(), known=known
+        )
+
+    async def _consensus_after_push(self) -> None:
+        try:
+            async with self.core_lock:
+                await self._run_consensus_locked(0)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.warning("post-push consensus failed: %s", e)
 
     async def _process_fast_forward_request(
         self, req: FastForwardRequest
@@ -393,7 +815,9 @@ class Node:
     # ------------------------------------------------------------------
     # outbound gossip (node.go:193-261)
 
-    async def _gossip(self, peer_addr: str) -> None:
+    async def _gossip(self, peer_addr: str) -> bool:
+        """The classic pull exchange (and the pipelined path's
+        reconciliation leg).  Returns True when a response was applied."""
         try:
             with self.tracer.span("gossip", peer=peer_addr):
                 async with self.core_lock:
@@ -408,8 +832,13 @@ class Node:
                     timeout=self.conf.tcp_timeout,
                 )
                 self._m_gossip_rtt.observe(time.perf_counter() - t0)
+                if resp.known:
+                    # authoritative re-seed of the push cache: the
+                    # responder's own clock at response time
+                    self._peer_known[peer_addr] = dict(resp.known)
                 await self._process_sync_response(resp)
                 self.peer_selector.update_last(peer_addr)
+                return True
         except asyncio.CancelledError:
             raise
         except TransportError as e:
@@ -421,12 +850,13 @@ class Node:
                 async with self.core_lock:
                     self.core.reset_gossip_backoff()
                 await self._fast_forward(peer_addr)
-                return
+                return False
             self._m_sync_errors.inc()
             self.logger.warning("gossip to %s failed: %s", peer_addr, e)
         except Exception as e:  # any failure counts against sync_rate
             self._m_sync_errors.inc()
             self.logger.warning("gossip to %s failed: %s", peer_addr, e)
+        return False
 
     def ff_max_caps(self) -> tuple:
         """(max_e, max_s, max_r) capacity bounds a fast-forward snapshot
@@ -616,8 +1046,7 @@ class Node:
     async def _process_sync_response(self, resp: SyncResponse) -> None:
         loop = asyncio.get_running_loop()
         async with self.core_lock:
-            payload = self.transaction_pool
-            self.transaction_pool = []
+            payload = self._take_payload()
             t0 = time.perf_counter()
             try:
                 # Device compute (incl. the first jit compile) runs in a
@@ -630,12 +1059,14 @@ class Node:
                     # byzantine merge-skip: events inserted but no
                     # self-event minted — the payload must ride a later
                     # sync instead of vanishing
-                    self.transaction_pool = payload + self.transaction_pool
+                    self._requeue(payload)
             except BaseException:
                 # the sync never produced a self-event carrying the pooled
                 # txs — put them back for the next attempt
-                self.transaction_pool = payload + self.transaction_pool
+                self._requeue(payload)
                 raise
+            if minted is not False and payload:
+                self._m_coalesce_txs.observe(len(payload))
             t1 = time.perf_counter()
             self._m_sync_seconds.observe(t1 - t0)
             self._m_gossip_events.inc(len(resp.events))
@@ -713,30 +1144,38 @@ class Node:
         """Deliver consensus transactions to the app, strictly in batch
         order (reference node.go:263-272 via commitCh).  Delivery is
         at-least-once: transient app failures are retried with backoff —
-        dropping would silently break the app's state-machine ordering."""
+        dropping would silently break the app's state-machine ordering.
+
+        Delivery is batched when the proxy supports it (commit_batch:
+        one RPC per consensus batch instead of one per tx — at fleet
+        commit rates the per-call round trip IS the app-side
+        bottleneck); an app answering `unknown method` demotes this
+        node to the reference per-tx protocol permanently."""
+        use_batch = getattr(self.proxy, "commit_batch", None)
         while True:
             events = await self._commit_queue.get()
             t0 = time.perf_counter()
-            for ev in events:
-                for tx in ev.transactions:
-                    delay = 0.2
-                    for attempt in range(8):
-                        try:
-                            await self.proxy.commit_tx(tx)
-                            self._m_commit_tx.inc()
-                            break
-                        except asyncio.CancelledError:
-                            raise
-                        except Exception as e:
-                            self._m_commit_retries.inc()
-                            self.logger.warning(
-                                "commit_tx failed (attempt %d): %s",
-                                attempt + 1, e,
-                            )
-                            await asyncio.sleep(delay)
-                            delay = min(delay * 2, 3.0)
-                    else:
-                        self.logger.error("commit_tx dropped after retries")
+            txs = [tx for ev in events for tx in ev.transactions]
+            if use_batch is not None and txs:
+                try:
+                    await self._deliver(use_batch, txs, len(txs),
+                                        probe=True)
+                    txs = []
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # only the unknown-method probe escapes _deliver
+                    # (transient failures retry inside it): demote to
+                    # the reference per-tx protocol and redeliver this
+                    # batch tx-by-tx — at-least-once is the app's
+                    # contract already
+                    self.logger.info(
+                        "app lacks State.CommitTxBatch (%s); falling "
+                        "back to per-tx commits", e,
+                    )
+                    use_batch = None
+            for tx in txs:
+                await self._deliver(self.proxy.commit_tx, tx, 1)
             dur = time.perf_counter() - t0
             self._m_commit_latency.observe(dur)
             self.tracer.record("commit_batch", dur, events=len(events))
@@ -744,6 +1183,34 @@ class Node:
             # alone cannot distinguish drained from batch-in-flight (the
             # chaos runner samples committed logs only once this fires)
             self._commit_queue.task_done()
+
+    async def _deliver(self, call, payload, n_txs: int,
+                       probe: bool = False) -> None:
+        """One at-least-once delivery (batch or single tx) with the
+        retry/backoff policy.  ``probe=True`` (the batch-verb capability
+        probe only) re-raises `unknown method` so the caller can demote
+        to the per-tx protocol; on the per-tx path the same error is
+        just another app failure — retried and at worst dropped with a
+        log line, never allowed to kill the committer task."""
+        delay = 0.2
+        for attempt in range(8):
+            try:
+                await call(payload)
+                self._m_commit_tx.inc(n_txs)
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if probe and "unknown method" in str(e):
+                    raise
+                self._m_commit_retries.inc()
+                self.logger.warning(
+                    "commit delivery failed (attempt %d): %s",
+                    attempt + 1, e,
+                )
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 3.0)
+        self.logger.error("commit delivery dropped after retries")
 
     def _random_timeout(self) -> float:
         """Randomized heartbeat pacing (reference node.go:345-351:

@@ -11,8 +11,15 @@ Implementations: in-memory pair for tests/embedding, and a JSON-RPC-over-
 TCP socket pair matching the reference's net/rpc/jsonrpc protocol shape.
 """
 
+from .admission import AdmissionQueue, OverloadedError
 from .inmem import InmemAppProxy
 from .socket_app import SocketAppProxy
 from .socket_babble import SocketBabbleProxy
 
-__all__ = ["InmemAppProxy", "SocketAppProxy", "SocketBabbleProxy"]
+__all__ = [
+    "AdmissionQueue",
+    "InmemAppProxy",
+    "OverloadedError",
+    "SocketAppProxy",
+    "SocketBabbleProxy",
+]

@@ -1,0 +1,187 @@
+"""Admission control: the ingress plane's front door (ISSUE 6 (d)).
+
+The submit path used to be an unbounded ``asyncio.Queue``: any client
+could grow it without limit (memory), and a single bombarding client
+could fill it faster than the node drains, starving every other
+client's transactions (FIFO is fair only among equals).  The
+:class:`AdmissionQueue` replaces it with:
+
+- **bounded queues** — one FIFO per client, capped at ``per_client``,
+  plus a ``total`` cap across clients;
+- **load shedding** — a submit over either cap is rejected immediately
+  with a structured :class:`OverloadedError` (JSON-RPC clients see
+  ``{"code": "overloaded", "scope": ..., "retry_after_ms": ...}``)
+  instead of queueing into unbounded latency;
+- **round-robin fairness** — the node drains one transaction per
+  client per turn, so a client bombarding at 100× the rate of the rest
+  gets at most an equal share of minted-event payload slots and cannot
+  starve anyone.
+
+The surface mirrors the ``asyncio.Queue`` subset the node's select
+loop uses (``get`` / ``get_nowait`` / ``qsize`` / ``empty``), so
+``Node.run`` drains it unchanged.  ``put``/``put_nowait`` exist for
+queue-compat callers (tests, dummy harnesses) and submit under a
+shared anonymous client id — real ingress goes through
+``submit_nowait(client, tx)`` with the connection's peer identity.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict, deque
+from typing import Deque, Dict, Optional
+
+#: client id used by queue-compat ``put``/``put_nowait`` callers
+ANON_CLIENT = "<anon>"
+
+
+class OverloadedError(Exception):
+    """Structured load-shed rejection.  ``scope`` says which cap was
+    hit (``client``: your own backlog; ``total``: the node's); clients
+    must back off ``retry_after_ms`` before resubmitting."""
+
+    def __init__(self, scope: str, depth: int, cap: int,
+                 retry_after_ms: int = 100, admitted: int = 0):
+        self.scope = scope
+        self.depth = depth
+        self.cap = cap
+        self.retry_after_ms = retry_after_ms
+        #: batched submits: how many txs of the batch WERE admitted
+        #: before the cap tripped — the client resubmits only the rest
+        self.admitted = admitted
+        super().__init__(
+            f"overloaded: {scope} submit queue at {depth}/{cap}"
+        )
+
+    def to_error(self) -> dict:
+        """The JSON-RPC structured error body (jsonrpc.py serializes
+        this verbatim; clients key off ``code``)."""
+        return {
+            "code": "overloaded",
+            "scope": self.scope,
+            "depth": self.depth,
+            "cap": self.cap,
+            "retry_after_ms": self.retry_after_ms,
+            "admitted": self.admitted,
+        }
+
+    @classmethod
+    def from_error(cls, err: dict) -> "OverloadedError":
+        return cls(
+            scope=str(err.get("scope", "total")),
+            depth=int(err.get("depth", 0)),
+            cap=int(err.get("cap", 0)),
+            retry_after_ms=int(err.get("retry_after_ms", 100)),
+            admitted=int(err.get("admitted", 0)),
+        )
+
+
+class AdmissionQueue:
+    """Bounded, per-client-fair submit queue (see module docstring)."""
+
+    def __init__(self, per_client: int = 1024, total: int = 8192,
+                 registry=None):
+        if per_client <= 0 or total <= 0:
+            raise ValueError("admission caps must be positive")
+        self.per_client = per_client
+        self.total = total
+        #: client -> FIFO; OrderedDict preserves round-robin rotation
+        #: order (move_to_end after each drain turn)
+        self._queues: "OrderedDict[str, Deque[bytes]]" = OrderedDict()
+        self._size = 0
+        self._data = asyncio.Event()
+        self._m_shed = None
+        self._m_admitted = None
+        if registry is not None:
+            self.instrument(registry)
+
+    def instrument(self, registry) -> None:
+        self._m_shed = registry.counter(
+            "babble_ingress_shed_total",
+            "submitted transactions rejected by admission control, by "
+            "which cap tripped",
+            labelnames=("scope",))
+        for scope in ("client", "total"):
+            self._m_shed.labels(scope)
+        self._m_admitted = registry.counter(
+            "babble_ingress_admitted_total",
+            "submitted transactions accepted into the admission queue")
+        registry.gauge(
+            "babble_ingress_queue_depth",
+            "transactions waiting in the admission queue across all "
+            "clients",
+        ).set_function(lambda: self._size)
+        registry.gauge(
+            "babble_ingress_clients",
+            "clients with a non-empty admission queue",
+        ).set_function(lambda: len(self._queues))
+
+    # ------------------------------------------------------------------
+    # ingress side
+
+    def submit_nowait(self, client: str, tx: bytes) -> None:
+        """Admit one transaction for ``client`` or shed it with a
+        structured OverloadedError."""
+        if self._size >= self.total:
+            if self._m_shed is not None:
+                self._m_shed.labels("total").inc()
+            raise OverloadedError("total", self._size, self.total)
+        q = self._queues.get(client)
+        if q is not None and len(q) >= self.per_client:
+            if self._m_shed is not None:
+                self._m_shed.labels("client").inc()
+            raise OverloadedError("client", len(q), self.per_client)
+        if q is None:
+            q = deque()
+            self._queues[client] = q
+        q.append(tx)
+        self._size += 1
+        if self._m_admitted is not None:
+            self._m_admitted.inc()
+        self._data.set()
+
+    # queue-compat writers (tests / in-process harnesses)
+
+    def put_nowait(self, tx: bytes) -> None:
+        self.submit_nowait(ANON_CLIENT, tx)
+
+    async def put(self, tx: bytes) -> None:
+        self.put_nowait(tx)
+
+    # ------------------------------------------------------------------
+    # drain side (the node's select loop)
+
+    def get_nowait(self) -> bytes:
+        """Pop one transaction, round-robin across clients: the head
+        client yields ONE tx and rotates to the tail, so every client
+        with backlog advances at the same rate regardless of depth."""
+        while self._queues:
+            client, q = next(iter(self._queues.items()))
+            if not q:
+                # emptied by a previous turn: drop the bookkeeping row
+                del self._queues[client]
+                continue
+            tx = q.popleft()
+            self._size -= 1
+            if q:
+                self._queues.move_to_end(client)
+            else:
+                del self._queues[client]
+            if self._size == 0:
+                self._data.clear()
+            return tx
+        self._data.clear()
+        raise asyncio.QueueEmpty
+
+    async def get(self) -> bytes:
+        while True:
+            try:
+                return self.get_nowait()
+            except asyncio.QueueEmpty:
+                await self._data.wait()
+
+    def qsize(self) -> int:
+        return self._size
+
+    def empty(self) -> bool:
+        return self._size == 0

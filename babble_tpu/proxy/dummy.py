@@ -22,6 +22,15 @@ class State:
         self.messages.append(msg)
         self.write_message(msg)
 
+    def commit_batch(self, txs) -> None:
+        """Batched commit (ingress plane): one append + one write for
+        the whole burst — at fleet commit rates the per-message
+        open/write/close syscall churn was measurable load."""
+        msgs = [tx.decode(errors="replace") for tx in txs]
+        self.messages.extend(msgs)
+        with open(self.log_path, "a") as f:
+            f.write("".join(m + "\n" for m in msgs))
+
     def write_message(self, msg: str) -> None:
         with open(self.log_path, "a") as f:
             f.write(msg + "\n")
@@ -46,7 +55,14 @@ class DummySocketClient:
     async def _run(self) -> None:
         while True:
             tx = await self.proxy.commit_queue.get()
-            self.state.commit_tx(tx)
+            # greedy drain: one wakeup commits the whole delivered burst
+            txs = [tx]
+            while True:
+                try:
+                    txs.append(self.proxy.commit_queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            self.state.commit_batch(txs)
 
     async def submit_tx(self, tx: bytes) -> None:
         await self.proxy.submit_tx(tx)

@@ -24,6 +24,11 @@ class InmemAppProxy:
     async def commit_tx(self, tx: bytes) -> None:
         self.committed.append(bytes(tx))
 
+    async def commit_batch(self, txs) -> None:
+        """Batched delivery (ingress plane): same committed order as N
+        commit_tx calls, one await."""
+        self.committed.extend(bytes(tx) for tx in txs)
+
     def committed_transactions(self) -> List[bytes]:
         return list(self.committed)
 

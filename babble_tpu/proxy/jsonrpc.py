@@ -71,42 +71,65 @@ class JsonStream:
 
 
 class JsonRpcServer:
-    """Serves registered methods over TCP."""
+    """Serves registered methods over TCP.
+
+    Methods registered with ``with_client=True`` receive the calling
+    connection's peer identity (``"ip:port"``) as a second argument —
+    the admission controller's per-client fairness key.  An exception
+    exposing ``to_error()`` (e.g. admission.OverloadedError) is
+    serialized as a STRUCTURED error object instead of a bare string,
+    so clients can key off ``error["code"]`` (the documented
+    ``overloaded`` contract) rather than parse prose."""
 
     def __init__(self, bind_addr: str):
         self.methods: Dict[str, Callable] = {}
+        self._with_client: set = set()
         self._server = AsyncTcpServer(bind_addr, self._handle)
 
     @property
     def bind_addr(self) -> str:
         return self._server.bind_addr
 
-    def register(self, name: str, fn: Callable) -> None:
-        """fn: async (param) -> result"""
+    def register(self, name: str, fn: Callable,
+                 with_client: bool = False) -> None:
+        """fn: async (param) -> result, or async (param, client) ->
+        result when registered with ``with_client=True``."""
         self.methods[name] = fn
+        if with_client:
+            self._with_client.add(name)
 
     async def start(self) -> None:
         await self._server.start()
 
     async def _handle(self, reader, writer) -> None:
         stream = JsonStream(reader)
+        peer = writer.get_extra_info("peername")
+        client = (f"{peer[0]}:{peer[1]}" if isinstance(peer, tuple)
+                  else str(peer))
         try:
             while True:
                 obj = await stream.next_obj()
                 if obj is None:
                     return
                 rid = obj.get("id")
-                method = self.methods.get(obj.get("method", ""))
+                name = obj.get("method", "")
+                method = self.methods.get(name)
                 if method is None:
                     resp = {"id": rid, "result": None,
                             "error": f"unknown method {obj.get('method')}"}
                 else:
                     try:
                         params = obj.get("params") or [None]
-                        result = await method(params[0])
+                        if name in self._with_client:
+                            result = await method(params[0], client)
+                        else:
+                            result = await method(params[0])
                         resp = {"id": rid, "result": result, "error": None}
                     except Exception as e:
-                        resp = {"id": rid, "result": None, "error": str(e)}
+                        to_error = getattr(e, "to_error", None)
+                        err = (to_error() if callable(to_error)
+                               else str(e))
+                        resp = {"id": rid, "result": None, "error": err}
                 writer.write(json.dumps(resp).encode())
                 await writer.drain()
         except JsonStreamError:
@@ -153,8 +176,16 @@ class JsonRpcClient:
             if resp is None:
                 self._conn = None
                 raise ConnectionError("connection closed mid-call")
-            if resp.get("error"):
-                raise RuntimeError(resp["error"])
+            err = resp.get("error")
+            if err:
+                if isinstance(err, dict) and err.get("code") == "overloaded":
+                    # the admission controller's structured shed: raise
+                    # the typed error so clients back off instead of
+                    # pattern-matching strings
+                    from .admission import OverloadedError
+
+                    raise OverloadedError.from_error(err)
+                raise RuntimeError(err)
             return resp.get("result")
 
     async def close(self) -> None:

@@ -3,23 +3,43 @@
 Runs a JSON-RPC server exposing ``Babble.SubmitTx`` (app → node submit
 queue) and a client calling ``State.CommitTx`` on the app for every
 consensus transaction, requiring an ack.
+
+Since the ingress-plane PR the submit queue is an
+:class:`~.admission.AdmissionQueue`: bounded per client and in total,
+drained round-robin so one bombarding client cannot starve the rest,
+and shedding load with the structured ``overloaded`` JSON-RPC error
+(clients must back off ``retry_after_ms``) instead of queueing into
+unbounded latency.  The client identity is the submitting connection's
+peer address, passed through by the JSON-RPC server.
 """
 
 from __future__ import annotations
 
-import asyncio
-
+from .admission import AdmissionQueue, OverloadedError
 from .jsonrpc import JsonRpcClient, JsonRpcServer, b64d, b64e
 
 
 class SocketAppProxy:
-    def __init__(self, client_addr: str, bind_addr: str, timeout: float = 5.0):
+    def __init__(self, client_addr: str, bind_addr: str, timeout: float = 5.0,
+                 submit_per_client: int = 1024, submit_total: int = 8192,
+                 registry=None):
         """client_addr: the app's State server; bind_addr: where we listen
         for the app's SubmitTx calls."""
-        self.submit_queue: "asyncio.Queue[bytes]" = asyncio.Queue()
+        self.submit_queue = AdmissionQueue(
+            per_client=submit_per_client, total=submit_total,
+            registry=registry,
+        )
         self.server = JsonRpcServer(bind_addr)
-        self.server.register("Babble.SubmitTx", self._submit_tx)
+        self.server.register("Babble.SubmitTx", self._submit_tx,
+                             with_client=True)
+        self.server.register("Babble.SubmitTxBatch", self._submit_tx_batch,
+                             with_client=True)
         self.client = JsonRpcClient(client_addr, timeout)
+
+    def instrument(self, registry) -> None:
+        """Land the admission series on the owning node's /metrics page
+        (the same late-binding seam the transports use)."""
+        self.submit_queue.instrument(registry)
 
     async def start(self) -> None:
         await self.server.start()
@@ -28,14 +48,43 @@ class SocketAppProxy:
     def bind_addr(self) -> str:
         return self.server.bind_addr
 
-    async def _submit_tx(self, tx_b64: str):
-        await self.submit_queue.put(b64d(tx_b64))
+    async def _submit_tx(self, tx_b64: str, client: str):
+        # raises admission.OverloadedError on a full queue — the JSON-RPC
+        # server serializes it as the structured `overloaded` error
+        self.submit_queue.submit_nowait(client, b64d(tx_b64))
+        return True
+
+    async def _submit_tx_batch(self, txs_b64: list, client: str):
+        """Batched submit: one RPC round trip admits many txs (the
+        per-call round trip bounds a single client's rate otherwise).
+        Admission stays per-tx: a cap mid-batch sheds the REST, and the
+        structured error's ``admitted`` count tells the client exactly
+        what to resubmit after the backoff."""
+        admitted = 0
+        try:
+            for tx_b64 in txs_b64:
+                self.submit_queue.submit_nowait(client, b64d(tx_b64))
+                admitted += 1
+        except OverloadedError as e:
+            e.admitted = admitted
+            raise
         return True
 
     async def commit_tx(self, tx: bytes) -> None:
         ack = await self.client.call("State.CommitTx", b64e(tx))
         if ack is not True:
             raise RuntimeError(f"app failed to ack committed tx: {ack!r}")
+
+    async def commit_batch(self, txs) -> None:
+        """One RPC for a whole commit batch (State.CommitTxBatch).  An
+        app speaking only the reference per-tx protocol answers
+        ``unknown method`` (a RuntimeError here) — the node's commit
+        loop catches that once and falls back to commit_tx for good."""
+        ack = await self.client.call(
+            "State.CommitTxBatch", [b64e(tx) for tx in txs]
+        )
+        if ack is not True:
+            raise RuntimeError(f"app failed to ack committed batch: {ack!r}")
 
     async def close(self) -> None:
         await self.server.close()

@@ -2,6 +2,11 @@
 
 Mirror image of SocketAppProxy: a server exposing ``State.CommitTx``
 (node → app commit queue) and a client calling ``Babble.SubmitTx``.
+Also serves ``State.CommitTxBatch`` (ingress plane): one RPC per commit
+batch instead of one per transaction — at fleet commit rates the
+per-call JSON round trip IS the app-side bottleneck.  Apps speaking
+only the reference protocol keep working: the node's proxy falls back
+to per-tx ``State.CommitTx`` when the batch verb is unknown.
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ class SocketBabbleProxy:
         self.commit_queue: "asyncio.Queue[bytes]" = asyncio.Queue()
         self.server = JsonRpcServer(bind_addr)
         self.server.register("State.CommitTx", self._commit_tx)
+        self.server.register("State.CommitTxBatch", self._commit_tx_batch)
         self.client = JsonRpcClient(node_addr, timeout)
 
     async def start(self) -> None:
@@ -29,6 +35,11 @@ class SocketBabbleProxy:
 
     async def _commit_tx(self, tx_b64: str):
         await self.commit_queue.put(b64d(tx_b64))
+        return True
+
+    async def _commit_tx_batch(self, txs_b64: list):
+        for tx_b64 in txs_b64:
+            await self.commit_queue.put(b64d(tx_b64))
         return True
 
     async def submit_tx(self, tx: bytes) -> None:

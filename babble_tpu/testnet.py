@@ -102,6 +102,10 @@ class TestnetRunner:
     #: per-node write-ahead logs (<datadir>/wal): restart recovery is
     #: seq-exact — the crash-restart chaos scenarios run honest on this
     wal: bool = False
+    #: pipelined gossip (speculative push + eager refill).  False runs
+    #: the fleet with --no_pipeline/--no_eager_gossip — the lockstep
+    #: reference shape, the ingress bench's A/B baseline
+    pipeline: bool = True
     # N processes sharing one host must not fight over a single accelerator;
     # set to "" to let each node pick its own default platform.
     jax_platform: str = "cpu"
@@ -148,6 +152,8 @@ class TestnetRunner:
             # recovery truncates and the seq probe then covers
             args += ["--wal_dir", os.path.join(d, "wal"),
                      "--wal_fsync", "batch(32,50)"]
+        if not self.pipeline:
+            args += ["--no_pipeline", "--no_eager_gossip"]
         if not self.with_clients:
             args.append("--no_client")
         return args
@@ -326,3 +332,78 @@ async def bombard(
         for c in clients:
             await c.close()
     return sent
+
+
+async def bombard_many(
+    n: int, clients: int = 16, rate: float = 1000.0, duration: float = 10.0,
+    ports: Optional[PortLayout] = None, seed: int = 0, tx_bytes: int = 32,
+    batch: int = 1,
+) -> Dict[str, int]:
+    """The many-client bombard harness (ISSUE 6): ``clients`` concurrent
+    JSON-RPC connections — each its own TCP connection, hence its own
+    admission-control fairness identity — spread round-robin over the
+    fleet, together targeting ~``rate`` tx/s.  ``batch`` > 1 submits
+    through ``Babble.SubmitTxBatch`` (one round trip per batch — a
+    single connection's rate is RTT-bound otherwise).  Clients handle
+    the structured ``overloaded`` shed the front door is contracted to
+    return: they back off ``retry_after_ms``, resubmitting only what
+    the error's ``admitted`` count says was refused — so the harness
+    measures sustained admitted throughput, not a queue filling once.
+    Returns {"sent", "shed", "errors", "clients"}."""
+    from .proxy.admission import OverloadedError
+    from .proxy.jsonrpc import JsonRpcClient, b64e
+
+    ports = ports or PortLayout()
+    counts = {"sent": 0, "shed": 0, "errors": 0, "clients": clients}
+    t_end = time.monotonic() + duration
+    per_client = max(rate / max(clients, 1), 0.001)
+    batch = max(1, batch)
+
+    async def one_client(ci: int) -> None:
+        import random
+
+        rng = random.Random((seed << 16) ^ ci)
+        node = ci % n
+        client = JsonRpcClient(ports.of(node)["submit"], timeout=15.0)
+        pad = "x" * max(tx_bytes - 24, 0)
+        seq = 0
+        pending: list = []
+        try:
+            while time.monotonic() < t_end:
+                while len(pending) < batch:
+                    pending.append(
+                        f"bomb{ci}-{seq}-"
+                        f"{rng.getrandbits(32):08x}{pad}".encode()
+                    )
+                    seq += 1
+                try:
+                    if batch == 1:
+                        await client.call(
+                            "Babble.SubmitTx", b64e(pending[0])
+                        )
+                        counts["sent"] += 1
+                        pending.clear()
+                    else:
+                        await client.call(
+                            "Babble.SubmitTxBatch",
+                            [b64e(p) for p in pending],
+                        )
+                        counts["sent"] += len(pending)
+                        pending.clear()
+                except OverloadedError as e:
+                    counts["sent"] += e.admitted
+                    counts["shed"] += len(pending) - e.admitted
+                    del pending[: e.admitted]
+                    await asyncio.sleep(e.retry_after_ms / 1000.0)
+                    continue
+                except (OSError, RuntimeError):
+                    counts["errors"] += 1
+                    pending.clear()     # unknown fate: don't double-send
+                    await asyncio.sleep(0.05)
+                    continue
+                await asyncio.sleep(batch / per_client)
+        finally:
+            await client.close()
+
+    await asyncio.gather(*(one_client(ci) for ci in range(clients)))
+    return counts

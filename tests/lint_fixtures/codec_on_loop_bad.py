@@ -1,0 +1,60 @@
+"""Fixture: wire codecs transcoding on the event loop (codec-on-loop).
+
+Big msgpack frames encoded/decoded inside a coroutine stall every other
+RPC and heartbeat for the duration; the sanctioned route is
+net/codec.py (size-gated off-loop transcode) or a run_in_executor
+closure.
+"""
+
+import struct
+
+import msgpack
+import msgpack as mp
+
+_HDR = struct.Struct(">BII")
+
+
+def build_snapshot(state):
+    # sync helper reaching msgpack: callers inside coroutines are the
+    # violation, this function itself is fine
+    return msgpack.packb(state, use_bin_type=True)
+
+
+class Transport:
+    async def send(self, writer, state):
+        body = msgpack.packb(state, use_bin_type=True)  # MARK: codec-on-loop
+        writer.write(body)
+
+    async def send_aliased(self, writer, state):
+        body = mp.packb(state, use_bin_type=True)  # MARK: codec-on-loop
+        writer.write(body)
+
+    async def recv(self, reader):
+        payload = await reader.read(65536)
+        return msgpack.unpackb(payload, raw=False)  # MARK: codec-on-loop
+
+    async def send_snapshot(self, writer, state):
+        body = build_snapshot(state)  # MARK: codec-on-loop
+        writer.write(body)
+
+    async def send_command(self, writer, req):
+        # duck-typed wire command: the graph can't resolve it, the name
+        # heuristic catches it
+        body = req.pack()  # MARK: codec-on-loop
+        writer.write(body)
+
+    async def header_is_fine(self, writer, rid, ln):
+        # clean: struct.Struct header codecs are a few fixed bytes
+        writer.write(_HDR.pack(0, rid, ln))
+
+    async def offload_is_fine(self, loop, state):
+        # clean: the codec runs in an executor-bound closure — the
+        # correct pattern, pruned from this coroutine's schedule
+        def work():
+            return msgpack.packb(state, use_bin_type=True)
+
+        return await loop.run_in_executor(None, work)
+
+    def sync_path(self, state):
+        # clean: not a coroutine — bulk/offline paths may pack inline
+        return msgpack.packb(state, use_bin_type=True)

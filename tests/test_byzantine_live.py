@@ -44,13 +44,10 @@ def test_fullwire_roundtrip_survives_msgpack():
     _sync(cores[0], cores[1])
     diff = cores[1].diff(cores[0].known())
     resp = SyncResponse(from_addr="x", head=cores[1].head,
-                       events=cores[1].to_wire(diff))
-    import msgpack
-
-    back = SyncResponse.unpack(msgpack.packb(
-        [resp.from_addr, resp.head, [e.pack() for e in resp.events]],
-        use_bin_type=True,
-    ))
+                       events=cores[1].to_wire(diff),
+                       known=cores[1].known())
+    back = SyncResponse.unpack(resp.pack())
+    assert back.known == cores[1].known()
     assert all(isinstance(w, FullWireEvent) for w in back.events)
     evs = [cores[0].hg.read_wire_info(w) for w in back.events]
     assert [e.hex() for e in evs] == [e.hex() for e in diff]

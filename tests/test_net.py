@@ -80,7 +80,7 @@ def test_tcp_transport_roundtrip():
 
 
 def test_tcp_transport_pooling():
-    """Two sequential syncs reuse the pooled connection."""
+    """Sequential syncs reuse the one multiplexed connection."""
 
     async def go():
         a = await new_tcp_transport("127.0.0.1:0")
@@ -96,8 +96,97 @@ def test_tcp_transport_pooling():
         t = asyncio.create_task(serve_two())
         req = SyncRequest(from_addr=a.local_addr(), known={})
         await a.sync(b.local_addr(), req)
-        assert len(a._pool[b.local_addr()]) == 1
+        conn = a._conns[b.local_addr()]
+        assert not conn.closed
         await a.sync(b.local_addr(), req)
+        assert a._conns[b.local_addr()] is conn, \
+            "second sync must reuse the multiplexed connection"
+        await t
+        await a.close()
+        await b.close()
+
+    asyncio.run(go())
+
+
+def test_tcp_mux_concurrent_rpcs_never_cross_responses():
+    """ISSUE 6 satellite: many concurrent in-flight RPCs on ONE
+    multiplexed connection each get the response to exactly the request
+    they sent, even when the server answers out of order."""
+
+    async def go():
+        a = await new_tcp_transport("127.0.0.1:0")
+        b = await new_tcp_transport("127.0.0.1:0")
+
+        async def scrambling_server():
+            # hold every rpc, then answer in reverse arrival order
+            held = []
+            for _ in range(24):
+                rpc = await b.consumer.get()
+                held.append(rpc)
+            for rpc in reversed(held):
+                rpc.respond(SyncResponse(
+                    from_addr=b.local_addr(),
+                    head=repr(sorted(rpc.command.known.items())),
+                    events=[],
+                ))
+
+        t = asyncio.create_task(scrambling_server())
+
+        async def one(i):
+            resp = await a.sync(
+                b.local_addr(),
+                SyncRequest(from_addr=a.local_addr(), known={0: i}),
+                timeout=10.0,
+            )
+            assert resp.head == repr([(0, i)]), \
+                f"waiter {i} got someone else's response: {resp.head}"
+
+        await asyncio.gather(*(one(i) for i in range(24)))
+        # all 24 rode one connection
+        assert len(a._conns) == 1
+        await t
+        await a.close()
+        await b.close()
+
+    asyncio.run(go())
+
+
+def test_tcp_mux_frame_cap_enforced_per_request_id():
+    """ISSUE 6 satellite: a response exceeding MAX_FRAME produces a
+    FrameTooLarge error frame for THAT request id only — the connection
+    survives and keeps serving later RPCs."""
+
+    async def go():
+        a = await new_tcp_transport("127.0.0.1:0")
+        b = await new_tcp_transport("127.0.0.1:0")
+
+        class Huge:
+            """Packs to > MAX_FRAME (exercises the post-encode cap)."""
+            def pack(self):
+                from babble_tpu.net.tcp_transport import MAX_FRAME
+                return b"\x00" * (MAX_FRAME + 1)
+
+            def approx_size(self):
+                return 0
+
+        async def server():
+            rpc1 = await b.consumer.get()
+            rpc1.respond(Huge())
+            rpc2 = await b.consumer.get()
+            rpc2.respond(SyncResponse(
+                from_addr=b.local_addr(), head="after", events=[]
+            ))
+
+        t = asyncio.create_task(server())
+        req = SyncRequest(from_addr=a.local_addr(), known={})
+        with pytest.raises(TransportError, match="frame cap"):
+            await a.sync(b.local_addr(), req, timeout=10.0)
+        conn = a._conns[b.local_addr()]
+        assert not conn.closed, \
+            "FrameTooLarge must be per-request-id, not per-connection"
+        resp = await a.sync(b.local_addr(), req, timeout=10.0)
+        assert resp.head == "after"
+        assert a._conns[b.local_addr()] is conn
         await t
         await a.close()
         await b.close()
@@ -159,7 +248,7 @@ def test_tcp_oversized_frame_closes_connection():
         host, port = b.bind_addr.rsplit(":", 1)
 
         reader, writer = await asyncio.open_connection(host, int(port))
-        writer.write(_HDR.pack(0, MAX_FRAME + 1))
+        writer.write(_HDR.pack(0, 1, MAX_FRAME + 1))
         await writer.drain()
         # server closes without reading the (absent) payload
         eof = await asyncio.wait_for(reader.read(1), 5.0)
@@ -199,11 +288,12 @@ def test_tcp_malformed_payload_rejected():
 
         reader, writer = await asyncio.open_connection(host, int(port))
         junk = b"\xff\x00garbage-not-msgpack"
-        writer.write(_HDR.pack(RPC_SYNC, len(junk)) + junk)
+        writer.write(_HDR.pack(RPC_SYNC, 7, len(junk)) + junk)
         await writer.drain()
         hdr = await asyncio.wait_for(reader.readexactly(_RHDR.size), 5.0)
-        ok, ln = _RHDR.unpack(hdr)
+        ok, rid, ln = _RHDR.unpack(hdr)
         assert ok == 1
+        assert rid == 7, "error frames carry the offending request id"
         msg = await asyncio.wait_for(reader.readexactly(ln), 5.0)
         assert b"malformed" in msg
         eof = await asyncio.wait_for(reader.read(1), 5.0)

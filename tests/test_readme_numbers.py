@@ -49,6 +49,29 @@ def test_rounds_to_fame_matches_artifact():
     )
 
 
+def test_ingress_numbers_match_artifact():
+    """The ingress-plane row quotes ordered tx/s and the same-host
+    baseline ratio; both must match BENCH_INGRESS.json (the ISSUE 6
+    measured-not-hoped contract)."""
+    path = os.path.join(ROOT, "BENCH_INGRESS.json")
+    if not os.path.exists(path):
+        pytest.skip("no ingress artifact")
+    with open(path) as f:
+        ing = json.load(f)
+    m = re.search(r"\|\s*ingress plane[^|]*\|\s*([\d.]+)\s*ordered tx/s"
+                  r"\s*\|\s*([\d.]+)x", _readme())
+    assert m, "README ingress row missing"
+    readme_tps, readme_ratio = float(m.group(1)), float(m.group(2))
+    artifact = float(ing["txs_per_sec_loaded"])
+    assert abs(readme_tps - artifact) / artifact < 0.10, (
+        f"README says {readme_tps} tx/s, BENCH_INGRESS.json says {artifact}"
+    )
+    ratio = float(ing["txs_vs_same_host_baseline"])
+    assert abs(readme_ratio - ratio) / ratio < 0.15, (
+        f"README says {readme_ratio}x, artifact says {ratio}x"
+    )
+
+
 def test_live_loaded_number_matches_artifact():
     """The LOADED fleet number must be quoted and pinned too (VERDICT r4
     weak #4: quoting only the idle-gossip figure hides the honest

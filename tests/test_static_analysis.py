@@ -138,6 +138,19 @@ def test_blocking_fixture_findings():
     assert len(findings) == 5, [f.format() for f in findings]
 
 
+def test_codec_on_loop_fixture_findings():
+    """ISSUE 6 satellite: msgpack encode/decode inside async def is
+    flagged — directly, through the project call graph, and through
+    the duck-typed .pack()/.unpack() name heuristic; struct.Struct
+    headers, executor-bound closures and sync paths stay clean."""
+    path = _fixture("codec_on_loop_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "codec-on-loop") == _marked_lines(
+        path, "codec-on-loop"
+    ), [f.format() for f in findings]
+    assert len(findings) == 5, [f.format() for f in findings]
+
+
 def test_invariants_fixture_findings():
     path = _fixture("invariants_bad.py")
     findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
