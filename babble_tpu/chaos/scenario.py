@@ -140,8 +140,13 @@ class ScenarioRunner:
     """Deterministic in-memory execution of one scenario."""
 
     def __init__(self, scenario: Scenario, seed: Optional[int] = None,
-                 consensus_every: int = 6):
+                 consensus_every: int = 6, kernel_class: str = "auto"):
         self.scenario = scenario
+        #: compiled-surface pin for the fused engine (node/config.py):
+        #: the incremental-vs-full parity suite runs the same scenario
+        #: under "latency" and "throughput" and asserts bit-identical
+        #: fingerprints
+        self.kernel_class = kernel_class
         self.seed = scenario.seed if seed is None else seed
         self.consensus_every = consensus_every
 
@@ -202,6 +207,7 @@ class ScenarioRunner:
             conf = Config.test_config(heartbeat=1.0)
             conf.cache_size = sc.cache_size
             conf.seq_window = sc.seq_window
+            conf.kernel_class = self.kernel_class
             conf.byzantine = (sc.engine == "byzantine")
             # positive interval with gossip=False means: syncs only mark
             # the pipeline dirty and the RUNNER decides when consensus
@@ -516,10 +522,12 @@ class ScenarioRunner:
 
 
 def run_scenario(scenario: Scenario,
-                 seed: Optional[int] = None) -> ScenarioResult:
+                 seed: Optional[int] = None,
+                 kernel_class: str = "auto") -> ScenarioResult:
     """One deterministic in-memory run; result carries the invariant
     report (``result.report.ok``)."""
-    return ScenarioRunner(scenario, seed=seed).run()
+    return ScenarioRunner(scenario, seed=seed,
+                          kernel_class=kernel_class).run()
 
 
 # ----------------------------------------------------------------------

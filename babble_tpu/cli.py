@@ -61,14 +61,17 @@ async def _run_node(args) -> int:
     # the cache each (kpad, tpad, bpad) combination costs a fresh multi-
     # second XLA compile on every node, every run — a compile storm that
     # dominates fleet throughput.
+    cache_dir = ""
     if args.jax_cache != "off":
-        import jax
+        from .ops import aot
 
         cache_dir = args.jax_cache or os.path.join(
             os.path.abspath(args.datadir), "jax_cache"
         )
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+        # one surface for the cache flags (ops/aot.py): persistent XLA
+        # cache + compile-event listeners; the AOT shape manifest lives
+        # in the same directory and Node prewarms from it at boot
+        aot.configure(cache_dir)
 
     from .crypto.keys import PemKeyFile
     from .net.peers import JSONPeers
@@ -169,6 +172,12 @@ async def _run_node(args) -> int:
                                    flag="--wide_caps"),
         wal_dir=getattr(args, "wal_dir", ""),
         wal_fsync=getattr(args, "wal_fsync", "batch"),
+        kernel_class=getattr(args, "kernel_class", "auto"),
+        # AOT prewarm shares the jit-cache root: the shape manifest
+        # sits beside the persistent XLA cache it replays into
+        aot_dir=(
+            "" if getattr(args, "no_aot_prewarm", False) else cache_dir
+        ),
     )
     conf.logger.setLevel(args.log_level.upper())
 
@@ -670,6 +679,15 @@ def main(argv=None) -> int:
                          "of growing)")
     rn.add_argument("--seq_window", type=int, default=0,
                     help="per-creator rolling window (0 = cache_size)")
+    rn.add_argument("--kernel_class", default="auto",
+                    choices=("auto", "latency", "throughput"),
+                    help="compiled-surface pin for the fused engine: "
+                         "auto picks the small-batch latency kernel for "
+                         "gossip-sized flushes, throughput for bulk")
+    rn.add_argument("--no_aot_prewarm", action="store_true",
+                    help="skip AOT pre-compilation of recorded live-flush "
+                         "shapes at boot (the persistent jit cache still "
+                         "applies)")
     rn.add_argument("--jax_cache", default="",
                     help="jit cache dir ('' = <datadir>/../jax_cache, 'off' = disabled)")
     rn.add_argument("--checkpoint_dir", default="",

@@ -21,8 +21,9 @@ two to bound recompilation.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -31,6 +32,7 @@ from ..common import OffsetList
 from ..core.dag import HostDag, InsertError
 from ..core.event import Event, WireEvent
 from ..ops import fame as fame_ops
+from ..ops import flush as flush_ops
 from ..ops import ingest as ingest_ops
 from ..ops import order as order_ops
 from ..ops.state import (
@@ -42,14 +44,33 @@ from ..ops.state import (
     compact as compact_op,
     grow_state,
     init_state,
+    ts32_ok,
 )
 
 _FD_FULL_THRESHOLD = 2048  # batch size above which full FD recompute wins
+
+#: pending-batch size above which the throughput path wins over the
+#: fused latency program (gossip flushes are tens of events; bulk
+#: ingest/catch-up ships thousands)
+LATENCY_K_MAX = 256
 
 _bucket = bucket
 
 
 class TpuHashgraph:
+    #: this engine supports the latency/throughput kernel split (the
+    #: fused live-flush program).  Subclasses with their own memory
+    #: layout (WideHashgraph: blocked la/fd, no fused coordinate
+    #: tensors) set this False and pin kernel_class via class attrs —
+    #: they inherit run_consensus_timed but always take the
+    #: three-phase branch through their own overrides.
+    KERNEL_SPLIT = True
+    # class-level defaults so subclasses that skip __init__ (the wide
+    # engine allocates its own state) still satisfy the dispatcher
+    finality_gate = False
+    kernel_class = "throughput"
+    last_kernel_class: Optional[str] = None
+
     def __init__(
         self,
         participants: Dict[str, int],
@@ -63,13 +84,46 @@ class TpuHashgraph:
         round_margin: int = 2,
         compact_min: Optional[int] = None,
         consensus_window: Optional[int] = None,
+        finality_gate: bool = False,
+        ts32: bool = False,
+        kernel_class: str = "auto",
     ):
         n = len(participants)
         self.participants = participants
         self.commit_callback = commit_callback
         self.dag = HostDag(participants, verify_signatures=verify_signatures)
-        self.cfg = DagConfig(n=n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap)
+        self.cfg = DagConfig(n=n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap,
+                             ts32=ts32)
         self.state: DagState = init_state(self.cfg)
+
+        # Streaming incremental engine (ROADMAP item 3):
+        # - finality_gate: witness-set finality (ops/wide.py complete=False
+        #   ported to the fused path) — a round's fame decides only once
+        #   every chain's head round has passed it, so prn whitening and
+        #   cts medians freeze on the same witness set fleet-wide.  The
+        #   live Core turns this on; whole-DAG batch paths keep the
+        #   ungated reference semantics.
+        # - kernel_class: "auto" picks the fused small-batch latency
+        #   program (ops/flush.live_flush) for gossip-sized flushes and
+        #   the legacy throughput phases for bulk ingest; "latency" /
+        #   "throughput" pin one path (parity tests, benches).
+        # - ts32: i32 relative timestamps in the order median (the span
+        #   guard below enforces ops.state.ts32_ok host-side).
+        if kernel_class not in ("auto", "latency", "throughput"):
+            raise ValueError(f"unknown kernel_class {kernel_class!r}")
+        self.finality_gate = finality_gate
+        self.kernel_class = kernel_class
+        self.last_kernel_class: Optional[str] = None
+        self._max_round_cache = -1        # host mirror of state.max_round
+        self._ts_lo: Optional[int] = None  # ts32 span guard mirrors
+        self._ts_hi: Optional[int] = None
+        #: AOT executable map: (W, gate, kpad, tpad, bpad) -> compiled
+        #: live_flush program (ops/aot.prewarm_engine fills it from the
+        #: manifest; a miss falls back to the jitted entry, which the
+        #: persistent XLA cache still serves across restarts)
+        self._aot: Dict[tuple, object] = {}
+        self._aot_dir: Optional[str] = None
+        self._aot_recorded: set = set()
 
         # Rolling-window policy (reference caches.go semantics; the live
         # node turns auto_compact on so memory stays bounded forever):
@@ -138,21 +192,25 @@ class TpuHashgraph:
     def insert_event(self, event: Event) -> None:
         self.dag.insert(event)
 
+    def _check_narrow_seq_range(self) -> None:
+        """la/fd hold ABSOLUTE seqs, which compaction never rebases:
+        narrow coordinates are only sound while every chain head is
+        clear of the dtype's INF sentinel (batch pipelines reset per
+        run; a long-lived compacting engine is not)."""
+        if not (self.cfg.coord16 or self.cfg.coord8):
+            return
+        head = max((len(c) for c in self.dag.chains), default=0)
+        if head >= int(self.cfg.fd_inf) - 1:
+            raise OverflowError(
+                f"narrow-coordinate engine exceeded seq range (head seq "
+                f"{head}); rebuild with wider coordinates"
+            )
+
     def flush(self) -> None:
         """Push pending host events through the device ingest pipeline."""
         if not self.dag.pending:
             return
-        if self.cfg.coord16 or self.cfg.coord8:
-            # la/fd hold ABSOLUTE seqs, which compaction never rebases:
-            # narrow coordinates are only sound while every chain head
-            # is clear of the dtype's INF sentinel (batch pipelines
-            # reset per run; a long-lived compacting engine is not)
-            head = max((len(c) for c in self.dag.chains), default=0)
-            if head >= int(self.cfg.fd_inf) - 1:
-                raise OverflowError(
-                    f"narrow-coordinate engine exceeded seq range (head seq "
-                    f"{head}); rebuild with wider coordinates"
-                )
+        self._check_narrow_seq_range()
         batch, fd_mode = self.build_batch()
         self.state = ingest_ops.ingest(self.cfg, self.state, fd_mode, batch)
         self._view = {}
@@ -161,7 +219,8 @@ class TpuHashgraph:
         # round increments may have been missed — grow the window and
         # recompute the suspect suffix (no full re-ingest: coordinates are
         # round-independent, and evicted history could not be replayed).
-        if int(self.state.max_round) - self._r_off >= self.cfg.r_cap - 1:
+        self._max_round_cache = int(self.state.max_round)
+        if self._max_round_cache - self._r_off >= self.cfg.r_cap - 1:
             self._repair_rounds()
 
     def _repair_rounds(self) -> None:
@@ -179,6 +238,7 @@ class TpuHashgraph:
             self.state = grow_state(self.state, self.cfg, new_cfg)
             self.cfg = new_cfg
             self._view = {}
+            self._aot = {}   # executables were compiled for the old shapes
 
             rnd = self._arr("round")
             ne = self.dag.n_events - base
@@ -203,7 +263,8 @@ class TpuHashgraph:
                     self.cfg, self.state, jnp.asarray(slot_sched)
                 )
                 self._view = {}
-            if int(self.state.max_round) - self._r_off < self.cfg.r_cap - 1:
+            self._max_round_cache = int(self.state.max_round)
+            if self._max_round_cache - self._r_off < self.cfg.r_cap - 1:
                 return
 
     def build_batch(self):
@@ -216,6 +277,18 @@ class TpuHashgraph:
         k = len(self.dag.pending)
         self._ensure_capacity(k)
         sp, op, creator, seq, ts, mbit, sched = self.dag.take_pending()
+        if self.cfg.ts32 and k:
+            # span guard for the i32 relative-timestamp median: rebasing
+            # is exact only while the live span fits int32 (state.ts32_ok)
+            lo, hi = int(ts.min()), int(ts.max())
+            self._ts_lo = lo if self._ts_lo is None else min(self._ts_lo, lo)
+            self._ts_hi = hi if self._ts_hi is None else max(self._ts_hi, hi)
+            if not ts32_ok(self._ts_lo, self._ts_hi):
+                raise OverflowError(
+                    f"ts32 engine exceeded the int32 timestamp span "
+                    f"({self._ts_hi - self._ts_lo} ns): rebuild with "
+                    "ts32=False (wall-clock fleets must keep i64)"
+                )
 
         kpad = _bucket(k)
         t, b = sched.shape
@@ -272,6 +345,7 @@ class TpuHashgraph:
             self.state = grow_state(self.state, cfg, new_cfg)
             self.cfg = new_cfg
             self._view = {}
+            self._aot = {}   # executables were compiled for the old shapes
 
     # ------------------------------------------------------------------
     # consensus pipeline
@@ -284,14 +358,21 @@ class TpuHashgraph:
         self.flush()
         # batch_window=False: the live engine rolls windows, so wide-N
         # fame must use the absolute-seq compare path (fame.py docstring)
-        self.state = fame_ops.decide_fame_auto(self.cfg, self.state, False)
+        self.state = fame_ops.decide_fame_auto(
+            self.cfg, self.state, False, self.finality_gate
+        )
         self._view = {}
 
     def find_order(self) -> List[Event]:
         self.flush()
         self.state = order_ops.decide_order(self.cfg, self.state)
         self._view = {}
+        return self._collect_ordered()
 
+    def _collect_ordered(self) -> List[Event]:
+        """Host half of the order phase, shared by the throughput and
+        latency kernels: read rr/cts, commit newly-received events in
+        consensus_sort order, roll the window."""
         rr = self._arr("rr")
         cts = self._arr("cts")
         base = self.dag.slot_base
@@ -337,9 +418,128 @@ class TpuHashgraph:
         return new_events
 
     def run_consensus(self) -> List[Event]:
+        events, _ = self.run_consensus_timed()
+        return events
+
+    def run_consensus_timed(self) -> Tuple[List[Event], Dict[str, float]]:
+        """One full consensus pass, dispatched per flush between the two
+        compiled surfaces (the tentpole's kernel split):
+
+        - **latency** — the fused ops/flush.live_flush program (one
+          launch: incremental ingest + W-round windowed fame/order over
+          persisted frontiers) for gossip-sized flushes; shape-bucketed
+          so a live stream shares one program.
+        - **throughput** — the legacy three-phase surface (full-table
+          fame, all-rounds order, batch fd strategies) for bulk
+          ingest/catch-up and any shape the window can't cover.
+
+        Both paths are bit-identical on the same flush sequence
+        (tests/test_flush.py parity suite); ``last_kernel_class``
+        records the pick for the node's flush histograms."""
+        t0 = time.perf_counter()
+        if self._latency_ok():
+            # _flush_live overwrites this with "throughput" when it
+            # internally degrades to the full-table phases (round
+            # repair, W undershoot) — the flush histogram must not
+            # book multi-second full-table passes under "latency"
+            self.last_kernel_class = "latency"
+            events = self._flush_live()
+            return events, {"flush_s": time.perf_counter() - t0}
+        self.last_kernel_class = "throughput"
         self.divide_rounds()
+        t1 = time.perf_counter()
         self.decide_fame()
-        return self.find_order()
+        t2 = time.perf_counter()
+        events = self.find_order()
+        t3 = time.perf_counter()
+        return events, {
+            "divide_rounds_s": t1 - t0,
+            "decide_fame_s": t2 - t1,
+            "find_order_s": t3 - t2,
+        }
+
+    def _latency_ok(self) -> bool:
+        """Host-mirror-only check (no device sync) that the fused
+        latency program can cover this flush exactly."""
+        if self.kernel_class == "throughput":
+            return False
+        k = len(self.dag.pending)
+        if self.kernel_class == "auto" and k > LATENCY_K_MAX:
+            return False
+        # the windowed median runs unchunked: past the chunk threshold
+        # the throughput path's blocked median must take over
+        if (self.cfg.e_cap + 1) * self.cfg.n > order_ops.MEDIAN_CHUNK_THRESHOLD:
+            return False
+        # open rounds the window must cover: the undecided span plus
+        # what this batch can add.  A topological level raises max_round
+        # by at most 1 but a round spans several levels in practice
+        # (same ~4:1 heuristic as _ensure_capacity); underestimating is
+        # SAFE — rounds past the window top simply defer to the next
+        # flush, whose estimate sees the updated max_round mirror —
+        # while the old levels-as-rounds estimate pushed routine gossip
+        # flushes onto the throughput surface for nothing
+        levels_new = len({self.dag.levels[s] for s in self.dag.pending})
+        est = (
+            self._max_round_cache - max(self._lcr_cache, -1)
+            + max(2, levels_new // 4 + 1)
+        )
+        w = flush_ops.bucket_w(max(est, 1), self.cfg.r_cap)
+        if w == 0:
+            return False
+        # the window slice must fit below the round-capacity edge with
+        # saturation headroom (the throughput path owns round repair)
+        top = max(self._lcr_cache + 1, 0) - self._r_off + w
+        if top > self.cfg.r_cap - 1:
+            return False
+        if self._max_round_cache + levels_new - self._r_off \
+                >= self.cfg.r_cap - 2:
+            return False
+        self._latency_w = w
+        return True
+
+    def _flush_live(self) -> List[Event]:
+        """One fused latency flush: build the (possibly empty) bucketed
+        batch, run live_flush with donated state (AOT executable when
+        prewarmed, jit otherwise), refresh host mirrors, commit."""
+        self._check_narrow_seq_range()
+        w = self._latency_w
+        batch, _ = self.build_batch()
+        key = (w, self.finality_gate, batch.sp.shape[0]) + batch.sched.shape
+        exe = self._aot.get(key)
+        if exe is not None:
+            self.state = exe(self.state, batch)
+        else:
+            self.state = flush_ops.live_flush(
+                self.cfg, w, self.finality_gate, self.state, batch
+            )
+            if self._aot_dir is not None and key not in self._aot_recorded:
+                # record the shape so the next restart can AOT-compile it
+                # against the persistent cache before the first flush
+                from ..ops import aot as aot_ops
+
+                self._aot_recorded.add(key)
+                aot_ops.record_shape(self._aot_dir, self.cfg, key)
+        self._view = {}
+        lcr_pre = self._lcr_cache
+        self._max_round_cache = int(self.state.max_round)
+        if self._max_round_cache - self._r_off >= self.cfg.r_cap - 1:
+            # headroom check should make this unreachable; degrade to the
+            # repairing throughput path rather than trust clipped rounds
+            self.last_kernel_class = "throughput"
+            self._repair_rounds()
+            self.decide_fame()
+            return self.find_order()
+        if self._max_round_cache > max(lcr_pre, -1) + w:
+            # the W estimate undershot (stale mirrors after a checkpoint
+            # restore, or a batch that raised rounds faster than the
+            # levels heuristic): rounds above the window top got no
+            # votes this pass.  run_consensus is run-to-completion, so
+            # finish with the full-table phases instead of deferring to
+            # a flush that may never come.
+            self.last_kernel_class = "throughput"
+            self.decide_fame()
+            return self.find_order()
+        return self._collect_ordered()
 
     # ------------------------------------------------------------------
     # rolling-window compaction (reference caches.go:45-76 applied to the

@@ -63,6 +63,14 @@ class WideHashgraph(TpuHashgraph):
     and raises if a batch cannot fit even after compaction (the node
     is misconfigured for its traffic, not transiently unlucky)."""
 
+    # no fused coordinate tensors -> no latency kernel; the inherited
+    # dispatcher always takes the three-phase branch through this
+    # class's divide_rounds/decide_fame/find_order (mid-stream fame
+    # already runs behind its own witness-set gate, complete=False)
+    KERNEL_SPLIT = False
+    kernel_class = "throughput"
+    finality_gate = False
+
     def __init__(
         self,
         participants: Dict[str, int],

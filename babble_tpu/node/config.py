@@ -100,6 +100,25 @@ class Config:
     # re-jits (tens of seconds each) otherwise starve gossip for
     # minutes after startup.
     fork_caps: tuple | None = None
+    # ---- streaming incremental engine (ROADMAP item 3) ----
+    # Compiled-surface selection for the fused engine: "auto" picks the
+    # small-batch latency kernel (one fused program over persisted
+    # device frontiers) for gossip-sized flushes and the throughput
+    # phases for bulk ingest; "latency"/"throughput" pin one path
+    # (parity tests, benches).  Wide/byzantine engines ignore this.
+    kernel_class: str = "auto"
+    # AOT compile cache: a directory makes the node record compiled
+    # live-flush shapes (babble_aot_manifest.json) and pre-compile them
+    # at boot against jax's persistent compilation cache, so a restart
+    # reaches its first flush in seconds instead of paying the full
+    # XLA compile storm.  "" disables prewarm (the jit path still uses
+    # whatever persistent cache dir the process configured).
+    aot_dir: str = ""
+    # Maximum continuation frames one gossip may stream when a push
+    # diff exceeds the per-frame event cap (deep catch-up pushes chain
+    # frames over the multiplexed connection instead of falling back
+    # to pull rounds); 0 restores single-frame pushes.
+    push_stream_max: int = 16
     # Durability plane (babble_tpu/wal): "" disables the write-ahead
     # log (the pre-WAL behavior — restarts may re-mint published seqs
     # unless a fresh checkpoint exists).  With a directory set, every

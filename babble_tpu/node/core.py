@@ -68,8 +68,10 @@ class Core:
         wide_caps: Optional[tuple] = None,
         registry: Optional[Registry] = None,
         wal: Optional[WriteAheadLog] = None,
+        kernel_class: str = "auto",
     ):
         self.id = core_id
+        self.kernel_class = kernel_class
         self.key = key
         self.pub_hex = key.pub_hex
         self.participants = participants
@@ -133,8 +135,16 @@ class Core:
                 auto_compact=bool(cache_size),   # 0/None = unbounded history
                 seq_window=seq_window or cache_size or 256,
                 consensus_window=2 * cache_size if cache_size else None,
+                # live semantics: a round's fame (and therefore its prn
+                # whitening and cts medians) freezes only once every
+                # chain's head round has passed it — the witness-set
+                # finality gate (ops/wide.py complete=False ported to
+                # the fused path; ROADMAP premature intra-round finality)
+                finality_gate=True,
+                kernel_class=kernel_class,
             )
         self.byzantine = byzantine
+        self._apply_live_engine_policy()
         if engine is not None:
             # a checkpoint-restored engine was built before this node's
             # registry existed: rebind its instruments (wide-engine
@@ -356,6 +366,19 @@ class Core:
 
     # ------------------------------------------------------------------
 
+    def _apply_live_engine_policy(self) -> None:
+        """Live-path engine semantics a restored/injected fused engine
+        must adopt: the witness-set finality gate (checkpoints and
+        fast-forward snapshots don't serialize it — it is a property of
+        the LIVE path, not of the DAG state) and this core's kernel-
+        class pin.  Both are per-call static arguments on the compiled
+        entries, so flipping the attributes is safe at any flush
+        boundary."""
+        if (isinstance(self.hg, TpuHashgraph)
+                and type(self.hg).KERNEL_SPLIT):
+            self.hg.finality_gate = True
+            self.hg.kernel_class = self.kernel_class
+
     def _rebind_engine_registry(self) -> None:
         """Point the current engine's instruments at this core's
         registry.  A bootstrap-restored or checkpoint-resumed engine was
@@ -421,6 +444,7 @@ class Core:
             self.head = ""
             self.seq = -1
             self.init()
+        self._apply_live_engine_policy()
         self._rebind_engine_registry()
 
     def _bootstrap_fork(self, engine) -> None:
@@ -486,6 +510,7 @@ class Core:
             self.head = ""
             self.seq = -1
             self.init()
+        self._apply_live_engine_policy()
         self._rebind_engine_registry()
 
     def _replay_own_tail(
@@ -662,14 +687,18 @@ class Core:
                 self._wal_append(ev)
                 self._adopt_own_event(ev)
         self._retry_wal_orphans()
-        if self.byzantine and other_head not in self.hg.dag.slot_of:
-            # the peer's head itself was skipped (its parents reference
-            # events we don't hold yet): keep everything inserted, but
-            # the merge event cannot name it — later gossip retries.
-            # Returning False tells the node NO self-event carried the
-            # payload, so it must re-queue the pooled transactions
-            # (silently dropping them here lost txs forever whenever a
-            # fleet's fork-resend raced the merge head).
+        if (other_head not in self.hg.dag.slot_of
+                and (self.byzantine or other_head)):
+            # the peer's head is not resolvable here — byzantine mode:
+            # its parents reference events we don't hold yet; honest
+            # mode: a truncated push frame (multi-frame catch-up) named
+            # a head beyond what it shipped.  Keep everything inserted,
+            # but the merge event cannot name it — later gossip (or the
+            # next continuation frame) retries.  Returning False tells
+            # the node NO self-event carried the payload, so it must
+            # re-queue the pooled transactions (silently dropping them
+            # here lost txs forever whenever a fleet's fork-resend
+            # raced the merge head).
             self.insert_failures += 1
             self.last_insert_error = "peer head not insertable; merge skipped"
             return False
@@ -705,7 +734,13 @@ class Core:
 
     def run_consensus(self) -> Tuple[List[Event], Dict[str, float]]:
         """DivideRounds → DecideFame → FindOrder with per-phase timings
-        (reference core.go:179-202)."""
+        (reference core.go:179-202).  The fused engine dispatches per
+        flush between its latency and throughput compiled surfaces
+        (engine.run_consensus_timed); fork/wide engines keep the
+        three-phase protocol."""
+        timed = getattr(self.hg, "run_consensus_timed", None)
+        if timed is not None:
+            return timed()
         t0 = time.perf_counter()
         self.hg.divide_rounds()
         t1 = time.perf_counter()

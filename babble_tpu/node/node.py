@@ -60,8 +60,13 @@ from .peer_selector import RandomPeerSelector
 
 #: /Stats timing keys are rendered from these phase histograms; the
 #: children are pre-created so /metrics shows the full consensus-phase
-#: distribution from boot, not from first observation
-_CONSENSUS_PHASES = ("divide_rounds", "decide_fame", "find_order")
+#: distribution from boot, not from first observation.  "flush" is the
+#: fused latency program (the streaming engine's single-launch path);
+#: the three legacy phases are the throughput surface.
+_CONSENSUS_PHASES = ("divide_rounds", "decide_fame", "find_order", "flush")
+
+#: kernel classes the flush histogram splits on (engine.last_kernel_class)
+_KERNEL_CLASSES = ("latency", "throughput")
 
 #: bounds for one speculative push frame.  The diff is topologically
 #: sorted and parents precede children, so a PREFIX is ancestry-closed
@@ -77,7 +82,12 @@ PUSH_MAX_BYTES = 4 * 1024 * 1024
 
 def _push_prefix(diff: List[Event]) -> List[Event]:
     """Ancestry-closed prefix of a topologically-sorted diff that fits
-    the push frame bounds (len()-based estimate, never encodes)."""
+    the push frame bounds (len()-based estimate, never encodes).  A
+    truncated diff no longer falls back to pull rounds: the sender
+    streams continuation frames over the multiplexed connection
+    (Node._gossip_push), each keyed on the peer's post-insert Known
+    from the previous ack, until the diff drains or
+    ``Config.push_stream_max`` frames have flown."""
     if len(diff) > PUSH_MAX_EVENTS:
         diff = diff[:PUSH_MAX_EVENTS]
     budget = PUSH_MAX_BYTES
@@ -145,7 +155,28 @@ class Node:
             wide=(getattr(conf, "engine", "fused") == "wide"),
             wide_caps=conf.wide_caps,
             registry=self.registry,
+            kernel_class=conf.kernel_class,
         )
+        # AOT compile cache (ops/aot.py): pre-compile the recorded
+        # live-flush shapes at boot — against the persistent XLA cache a
+        # restart reaches its first flush in seconds — and surface the
+        # compile/cache counters on this node's /metrics
+        if conf.aot_dir:
+            from ..consensus.engine import TpuHashgraph as _Fused
+            from ..ops import aot as _aot
+
+            _aot.bind_registry(self.registry)
+            # KERNEL_SPLIT excludes engines without the fused latency
+            # surface (WideHashgraph subclasses TpuHashgraph but owns
+            # its own blocked state — prewarming live_flush programs
+            # for it would be wasted compiles at best)
+            if (isinstance(self.core.hg, _Fused)
+                    and type(self.core.hg).KERNEL_SPLIT):
+                res = _aot.prewarm_engine(self.core.hg, conf.aot_dir)
+                self.logger.info(
+                    "AOT prewarm: %d programs compiled (%d from manifest)",
+                    res["compiled"], res["from_manifest"],
+                )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
         # heartbeat pacing draws from a per-identity seeded stream, not
@@ -224,6 +255,15 @@ class Node:
             labelnames=("phase",))
         for phase in _CONSENSUS_PHASES:
             self._m_phase_seconds.labels(phase)
+        # flush wall time split by compiled-surface class: the latency
+        # kernel's distribution is the <5 ms/flush acceptance series,
+        # the throughput kernel's the bulk-ingest one
+        self._m_flush_seconds = m.histogram(
+            "babble_flush_seconds",
+            "consensus flush wall time per kernel class",
+            labelnames=("kernel",))
+        for kc in _KERNEL_CLASSES:
+            self._m_flush_seconds.labels(kc)
         self._m_gossip_skipped = m.counter(
             "babble_gossip_skipped_total",
             "heartbeats that launched no gossip because gossip_inflight "
@@ -239,6 +279,10 @@ class Node:
         self._m_push_apply = m.histogram(
             "babble_push_apply_seconds",
             "insert+mint wall time per applied inbound push")
+        self._m_push_frames = m.counter(
+            "babble_push_stream_frames_total",
+            "continuation frames streamed for push diffs past the "
+            "per-frame event cap (deep catch-up without pull rounds)")
         self._m_coalesce_txs = m.histogram(
             "babble_coalesce_batch_txs",
             "client transactions coalesced into one minted event",
@@ -632,28 +676,60 @@ class Node:
         loop = asyncio.get_running_loop()
         try:
             with self.tracer.span("push", peer=peer_addr):
-                async with self.core_lock:
-                    def work():
-                        diff = _push_prefix(self.core.diff(peer_known))
-                        return (self.core.to_wire(diff), self.core.known(),
-                                self.core.head)
+                known_view = peer_known
+                frames = 0
+                while True:
+                    async with self.core_lock:
+                        def work():
+                            diff = self.core.diff(known_view)
+                            prefix = _push_prefix(diff)
+                            head = self.core.head
+                            if len(prefix) < len(diff):
+                                # truncated frame: our absolute head is
+                                # NOT shipped, and the receiver's merge
+                                # mint names the head as other-parent —
+                                # point it at the newest own event this
+                                # frame delivers instead (the receiver
+                                # guards against unresolvable heads
+                                # either way, Core.sync)
+                                own = [e for e in prefix
+                                       if e.creator == self.core.pub_hex]
+                                if own:
+                                    head = own[-1].hex()
+                            return (self.core.to_wire(prefix),
+                                    self.core.known(), head,
+                                    len(diff) - len(prefix))
 
-                    wire, my_known, head = await loop.run_in_executor(
-                        None, work
+                        wire, my_known, head, rest = (
+                            await loop.run_in_executor(None, work)
+                        )
+                    self._m_push_total.inc()
+                    t0 = time.perf_counter()
+                    resp = await self.transport.request(
+                        peer_addr,
+                        PushRequest(
+                            from_addr=self.transport.local_addr(),
+                            known=my_known, head=head, events=wire,
+                        ),
+                        timeout=self.conf.tcp_timeout,
                     )
-                self._m_push_total.inc()
-                t0 = time.perf_counter()
-                resp = await self.transport.request(
-                    peer_addr,
-                    PushRequest(
-                        from_addr=self.transport.local_addr(),
-                        known=my_known, head=head, events=wire,
-                    ),
-                    timeout=self.conf.tcp_timeout,
-                )
-                self._m_push_rtt.observe(time.perf_counter() - t0)
-                self._peer_known[peer_addr] = dict(resp.known)
-                self.peer_selector.update_last(peer_addr)
+                    self._m_push_rtt.observe(time.perf_counter() - t0)
+                    self._peer_known[peer_addr] = dict(resp.known)
+                    known_view = dict(resp.known)
+                    self.peer_selector.update_last(peer_addr)
+                    frames += 1
+                    # multi-frame streaming: a diff past the per-frame
+                    # cap (deep catch-up) chains continuation frames
+                    # over the same multiplexed connection, each keyed
+                    # on the peer's authoritative post-insert Known —
+                    # instead of shipping one frame per heartbeat and
+                    # leaving the tail to pull rounds.  The frame cap
+                    # bounds one stream; the busy-peer guard already
+                    # keeps concurrent pushes off this target.
+                    if rest > 0 and frames <= self.conf.push_stream_max:
+                        self._m_push_frames.inc()
+                        continue
+                    break
                 # reconciliation trigger: the peer knows events of a
                 # THIRD creator (or of us) that we lack — pull now.
                 # The peer's OWN column is deliberately excluded: it is
@@ -1111,6 +1187,9 @@ class Node:
                 phase = k[: -len("_s")]
                 self._m_phase_seconds.labels(phase).observe(v)
                 self.tracer.record(phase, v)
+        kc = getattr(self.core.hg, "last_kernel_class", None)
+        if kc in _KERNEL_CLASSES:
+            self._m_flush_seconds.labels(kc).observe(t2 - t1)
         self._m_consensus_seconds.observe(t2 - t1)
         self.logger.debug(
             "sync %d events, consensus %.1fms",

@@ -1,0 +1,271 @@
+"""AOT compilation cache for the consensus kernels.
+
+Kills the cold-start tax (ROADMAP item 3): every BENCH_r0* config paid
+19-37 s of XLA compile+first-run, bench r04 blew a 1500 s watchdog on
+it, and a fleet restart re-paid the whole bill.  Three layers:
+
+1. **Persistent XLA cache** (``configure``): jax's compilation cache
+   directory, so a recompile of an already-seen program is a
+   deserialize (sub-second) instead of a full XLA pass.  The cli/
+   testnet already share one directory per fleet; bench and the
+   prewarm path route through here so every surface agrees on the
+   flags.
+2. **Shape manifest** (``record_shape`` / ``load_manifest``): the
+   engine records every live-flush program it actually compiled —
+   keyed on the ``DagConfig`` + ``ENGINE_CACHE_VERSION`` + the bucketed
+   batch/window shape — into ``babble_aot_manifest.json`` beside the
+   cache.  A restart replays the manifest BEFORE the first flush.
+3. **AOT executables** (``prewarm_engine``): each manifest entry is
+   ``jit(...).lower(...).compile()``-d against abstract
+   ``ShapeDtypeStruct`` inputs and parked in the engine's ``_aot`` map,
+   so the first live flush calls a ready executable — no trace, no
+   dispatch-path compile, and (warm) the XLA work is a cache
+   deserialize.
+
+Compile visibility: ``bind_registry`` maps jax's monitoring events onto
+``babble_compile_cache_hits_total`` / ``_misses_total`` /
+``babble_xla_compiles_total``, and ``compile_counts()`` exposes the
+same numbers to tests (the compile-count regression suite asserts a
+same-shape flush stream triggers zero of them).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .state import DagConfig, init_state
+
+#: bump when a change to the flush/ingest/fame/order kernels makes old
+#: manifest entries meaningless (the persistent XLA cache keys on HLO
+#: and self-invalidates; this guards OUR shape replay layer)
+ENGINE_CACHE_VERSION = "7.0"
+
+_MANIFEST = "babble_aot_manifest.json"
+
+# ----------------------------------------------------------------------
+# compile-event counters (jax.monitoring -> obs registries + tests)
+
+_stats = {"cache_hits": 0, "cache_misses": 0, "xla_compiles": 0,
+          "traces": 0}
+_bound: List[dict] = []          # registry counters fed by the listeners
+_installed = False
+
+
+def _on_event(name: str, **kw) -> None:
+    key = None
+    if name == "/jax/compilation_cache/cache_hits":
+        key = "cache_hits"
+    elif name == "/jax/compilation_cache/cache_misses":
+        key = "cache_misses"
+    if key is None:
+        return
+    _stats[key] += 1
+    for b in _bound:
+        b[key].inc()
+
+
+def _on_duration(name: str, dur: float, **kw) -> None:
+    key = None
+    if name.endswith("backend_compile_duration"):
+        key = "xla_compiles"
+    elif name.endswith("jaxpr_trace_duration"):
+        key = "traces"
+    if key is None:
+        return
+    _stats[key] += 1
+    for b in _bound:
+        b[key].inc()
+
+
+def install_listeners() -> None:
+    """Register the jax.monitoring listeners once per process (jax has
+    no unregister; the listeners fan out to every bound registry)."""
+    global _installed
+    if _installed:
+        return
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _installed = True
+
+
+def bind_registry(registry) -> None:
+    """Expose the compile counters on a node/bench registry."""
+    install_listeners()
+    _bound.append({
+        "cache_hits": registry.counter(
+            "babble_compile_cache_hits_total",
+            "persistent-compilation-cache hits (XLA compile skipped)"),
+        "cache_misses": registry.counter(
+            "babble_compile_cache_misses_total",
+            "persistent-compilation-cache misses (full XLA compile paid)"),
+        "xla_compiles": registry.counter(
+            "babble_xla_compiles_total",
+            "XLA backend compiles (cache deserializes excluded... "
+            "counted per backend_compile event)"),
+        "traces": registry.counter(
+            "babble_jit_traces_total",
+            "jaxpr traces (a same-shape flush stream must add zero)"),
+    })
+
+
+def compile_counts() -> Dict[str, int]:
+    """Process-wide compile/trace counters (the regression tests'
+    compilation hook).  install_listeners() must have run first."""
+    return dict(_stats)
+
+
+# ----------------------------------------------------------------------
+# persistent XLA cache
+
+def configure(cache_dir: str) -> None:
+    """Point jax's persistent compilation cache at ``cache_dir`` (every
+    surface — cli, testnet, bench, prewarm — routes through here so the
+    flags agree).  Idempotent; safe before or after backend init."""
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # the live-flush latency program is deliberately small — without
+    # this floor it would fall under jax's default 1 s minimum and
+    # never persist, which is exactly the program we restart for
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    install_listeners()
+
+
+# ----------------------------------------------------------------------
+# shape manifest
+
+def _cfg_key(cfg: DagConfig) -> list:
+    return list(cfg)
+
+
+def manifest_path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, _MANIFEST)
+
+
+def load_manifest(cache_dir: str) -> List[dict]:
+    try:
+        with open(manifest_path(cache_dir)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(data, dict) or data.get("version") != \
+            ENGINE_CACHE_VERSION:
+        return []
+    entries = data.get("entries")
+    return entries if isinstance(entries, list) else []
+
+
+def record_shape(cache_dir: str, cfg: DagConfig, key: tuple) -> None:
+    """Append one compiled live-flush shape (idempotent; best-effort —
+    a read-only cache dir only loses prewarm).  The read-modify-replace
+    runs under an flock'd sidecar: fleet nodes share one cache dir, and
+    without the lock concurrent writers drop each other's entries
+    (last-writer-wins), silently re-arming the compile storm the
+    manifest exists to kill."""
+    try:
+        import fcntl
+
+        os.makedirs(cache_dir, exist_ok=True)
+        with open(manifest_path(cache_dir) + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            entries = load_manifest(cache_dir)
+            entry = {"cfg": _cfg_key(cfg), "key": list(key)}
+            if entry in entries:
+                return
+            entries.append(entry)
+            tmp = manifest_path(cache_dir) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"version": ENGINE_CACHE_VERSION,
+                           "entries": entries}, f)
+            os.replace(tmp, manifest_path(cache_dir))
+    except (OSError, ImportError):
+        pass
+
+
+# ----------------------------------------------------------------------
+# AOT prewarm
+
+#: shapes compiled when the manifest has nothing for this cfg yet: the
+#: smallest gossip buckets (an 8-event flush with 1-4 topological
+#: levels under the first W bucket) — the programs a fresh live fleet
+#: hits within its first heartbeats
+_DEFAULT_SHAPES: Tuple[Tuple[int, Tuple[int, int]], ...] = (
+    (8, (1, 4)),
+    (8, (2, 4)),
+)
+
+
+def _batch_struct(kpad: int, tb: Tuple[int, int]):
+    from .ingest import EventBatch
+
+    sds = jax.ShapeDtypeStruct
+    return EventBatch(
+        sp=sds((kpad,), jnp.int32),
+        op=sds((kpad,), jnp.int32),
+        creator=sds((kpad,), jnp.int32),
+        seq=sds((kpad,), jnp.int32),
+        ts=sds((kpad,), jnp.int64),
+        mbit=sds((kpad,), jnp.bool_),
+        k=sds((), jnp.int32),
+        sched=sds(tuple(tb), jnp.int32),
+    )
+
+
+def prewarm_engine(engine, cache_dir: str,
+                   defaults: bool = True,
+                   limit: Optional[int] = None) -> Dict[str, int]:
+    """AOT-compile the live-flush programs this engine will need.
+
+    Replays the manifest entries recorded for this exact
+    (DagConfig, ENGINE_CACHE_VERSION) — plus the default gossip shapes
+    when the manifest holds none — into the engine's executable map.
+    With a populated persistent cache the XLA work is a deserialize,
+    so a fleet restart reaches its first flush in seconds; cold, this
+    is the same compile the first flush would have paid, just moved
+    to boot where it cannot stall gossip.  ``limit`` caps how many
+    manifest entries prewarm (oldest first — manifest order is usage
+    order, so early entries are the shapes the first flushes hit);
+    later shapes still deserialize from the persistent cache on first
+    use, they just pay their trace mid-stream instead of at boot.
+
+    Returns {"compiled": n, "from_manifest": m}."""
+    from . import flush as flush_ops
+
+    configure(cache_dir)
+    engine._aot_dir = cache_dir
+    cfg = engine.cfg
+    gate = engine.finality_gate
+
+    keys = []
+    from_manifest = 0
+    for e in load_manifest(cache_dir):
+        if e.get("cfg") == _cfg_key(cfg):
+            if limit is not None and from_manifest >= limit:
+                break
+            keys.append(tuple(e["key"]))
+            from_manifest += 1
+    if not keys and defaults:
+        w0 = flush_ops.bucket_w(1, cfg.r_cap)
+        if w0:
+            keys = [(w0, gate, kpad) + tb for kpad, tb in _DEFAULT_SHAPES]
+
+    state_sds = jax.eval_shape(lambda: init_state(cfg))
+    compiled = 0
+    for key in keys:
+        if key in engine._aot:
+            continue
+        w, kgate, kpad, t, b = key
+        if w > cfg.r_cap or kgate != gate:
+            continue
+        lowered = flush_ops.live_flush.lower(
+            cfg, int(w), bool(kgate), state_sds,
+            _batch_struct(int(kpad), (int(t), int(b))),
+        )
+        engine._aot[key] = lowered.compile()
+        engine._aot_recorded.add(key)
+        compiled += 1
+    return {"compiled": compiled, "from_manifest": from_manifest}

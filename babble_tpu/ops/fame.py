@@ -41,6 +41,7 @@ from .state import (
     DagConfig,
     DagState,
     I32,
+    head_round_min_math,
     sanitize,
 )
 
@@ -48,9 +49,24 @@ F32 = jnp.float32
 BF16 = jnp.bfloat16
 
 
-def decide_fame_impl(cfg: DagConfig, state: DagState) -> DagState:
+def decide_fame_impl(cfg: DagConfig, state: DagState,
+                     gate: bool = False) -> DagState:
     """Unjitted body — composable under an outer jit (graft entry, sharded
-    pipeline).  Use ``decide_fame`` for the standalone jitted form."""
+    pipeline).  Use ``decide_fame`` for the standalone jitted form.
+
+    ``gate=True`` (static) applies the witness-set finality gate the
+    wide pipeline decides behind (ops/wide.py ``complete=False``): a
+    round's fame may only be DECIDED once every chain's head round has
+    passed it (state.head_round_min_math), i.e. once its witness set is
+    provably final.  Without the gate, a round whose late witness is
+    still in flight can decide, freeze its famous set, and commit —
+    after which the late witness lands famous=UNDEFINED on this node
+    but FAME_TRUE/FALSE on a node that saw it in time, permuting the
+    round's prn whitening and cts medians across honest nodes (the
+    ROADMAP "premature intra-round finality" defect; chaos slow-peer
+    seed 1).  The live engine runs gated; whole-DAG batch/sim paths
+    keep the ungated reference semantics (every witness has arrived by
+    construction, so the gate would only defer the top rounds)."""
     n, r_cap, sm = cfg.n, cfg.r_cap, cfg.super_majority
     R = r_cap
 
@@ -91,6 +107,8 @@ def decide_fame_impl(cfg: DagConfig, state: DagState) -> DagState:
     # table row i holds absolute round i + r_off (rolling round window)
     i_idx = jnp.arange(R, dtype=I32) + state.r_off
     in_window = (i_idx > state.lcr) & (i_idx < state.max_round)
+    if gate:
+        in_window = in_window & (i_idx <= head_round_min_math(cfg, state))
 
     def step(d, carry):
         votes, famous = carry
@@ -139,7 +157,9 @@ def decide_fame_impl(cfg: DagConfig, state: DagState) -> DagState:
     # decided (matching the reference's ascending set-on-each-decided-i loop)
     decided_round = ((~valid_w) | (famous != FAME_UNDEFINED)).all(axis=1)
     has_w = valid_w.any(axis=1)
-    cand = in_window & decided_round & has_w
+    cand = _lcr_candidates(
+        state, i_idx, in_window, decided_round, has_w, gate
+    )
     new_lcr = jnp.max(jnp.where(cand, i_idx, -1))
     lcr = jnp.maximum(state.lcr, new_lcr)
 
@@ -147,7 +167,38 @@ def decide_fame_impl(cfg: DagConfig, state: DagState) -> DagState:
     return state._replace(famous=famous_out, lcr=lcr)
 
 
-decide_fame = jax.jit(decide_fame_impl, static_argnums=(0,), donate_argnums=(1,))
+def _lcr_candidates(state, i_idx, in_window, decided_round, has_w,
+                    gate: bool):
+    """Rounds lcr may advance to.
+
+    Ungated (reference semantics, hashgraph.go:654-673): every decided
+    in-window round — the max can JUMP an undecided round, permanently
+    abandoning it (fame only votes rounds > lcr).
+
+    Gated (live semantics): the CONTIGUOUS decided prefix only.  Which
+    rounds decide at a given flush depends on which voting-round
+    witnesses have arrived — per-node timing — so the jump converts
+    decision timing into per-node round-received splits: a node that
+    decided round r in time receives events there (rr=r), one whose
+    lcr jumped r receives them a round later (rr=r+1), and the fleet
+    commits the same events under different prn/cts cohorts (the
+    OBSERVED half of the premature-finality defect; chaos slow-peer
+    seed 1, events 52-54).  Stopping at the first undecided round
+    keeps it votable (in_window = i > lcr), so every node eventually
+    decides it with the gate-final witness set and assigns identical
+    rr."""
+    if not gate:
+        return in_window & decided_round & has_w
+    passing = in_window & decided_round
+    fail = (i_idx > state.lcr) & ~passing
+    first_fail = jnp.min(
+        jnp.where(fail, i_idx, jnp.iinfo(I32).max)
+    )
+    return passing & has_w & (i_idx < first_fail)
+
+
+decide_fame = jax.jit(decide_fame_impl, static_argnums=(0, 2),
+                      donate_argnums=(1,))
 
 
 # diagonal-scan working-set bound (elements of [R, N, N]) above which the
@@ -166,7 +217,8 @@ def fame_mode(cfg: DagConfig) -> str:
 
 
 def decide_fame_block_impl(
-    cfg: DagConfig, state: DagState, batch_window: bool = True
+    cfg: DagConfig, state: DagState, batch_window: bool = True,
+    gate: bool = False,
 ) -> DagState:
     """Memory-blocked DecideFame for wide participant axes.
 
@@ -220,10 +272,17 @@ def decide_fame_block_impl(
         )
 
     lo = jnp.clip(state.lcr + 1 - state.r_off, 0, R)
-    hi = jnp.clip(state.max_round - state.r_off, 0, R)
+    hi_abs = state.max_round
+    if gate:
+        # witness-set finality gate (see decide_fame_impl docstring):
+        # only rounds every chain's head has passed may decide
+        hi_abs = jnp.minimum(
+            hi_abs, head_round_min_math(cfg, state) + 1
+        )
+    hi = jnp.clip(hi_abs - state.r_off, 0, R)
     famous_out = jax.lax.fori_loop(lo, hi, round_body, state.famous)
     return state._replace(
-        famous=famous_out, lcr=fame_advance_lcr(cfg, state, famous_out)
+        famous=famous_out, lcr=fame_advance_lcr(cfg, state, famous_out, gate)
     )
 
 
@@ -297,7 +356,8 @@ def fame_vote_math(
     return votes, famous_i
 
 
-def fame_advance_lcr(cfg: DagConfig, state: DagState, famous_out):
+def fame_advance_lcr(cfg: DagConfig, state: DagState, famous_out,
+                     gate: bool = False):
     """Advance last consensus round: highest window round with all
     witnesses decided (same reduction as the diagonal scan)."""
     R = cfg.r_cap
@@ -305,11 +365,15 @@ def fame_advance_lcr(cfg: DagConfig, state: DagState, famous_out):
     valid_w = wsl >= 0
     i_idx = jnp.arange(R, dtype=I32) + state.r_off
     in_window = (i_idx > state.lcr) & (i_idx < state.max_round)
+    if gate:
+        in_window = in_window & (i_idx <= head_round_min_math(cfg, state))
     decided_round = (
         (~valid_w) | (famous_out[:R] != FAME_UNDEFINED)
     ).all(axis=1)
     has_w = valid_w.any(axis=1)
-    cand = in_window & decided_round & has_w
+    cand = _lcr_candidates(
+        state, i_idx, in_window, decided_round, has_w, gate
+    )
     new_lcr = jnp.max(jnp.where(cand, i_idx, -1))
     return jnp.maximum(state.lcr, new_lcr)
 
@@ -319,17 +383,18 @@ def _wrow(tab, r_loc):
 
 
 def decide_fame_auto_impl(
-    cfg: DagConfig, state: DagState, batch_window: bool = True
+    cfg: DagConfig, state: DagState, batch_window: bool = True,
+    gate: bool = False,
 ) -> DagState:
     """Static shape-based dispatch between the two DecideFame forms."""
     if fame_mode(cfg) == "block":
-        return decide_fame_block_impl(cfg, state, batch_window)
-    return decide_fame_impl(cfg, state)
+        return decide_fame_block_impl(cfg, state, batch_window, gate)
+    return decide_fame_impl(cfg, state, gate)
 
 
 # Rolled-window-safe jitted form for the live engine: blockwise fame past
 # the working-set bound, with the absolute-seq compare path (one-hot needs
 # the fresh-state window invariant the live engine can't promise).
 decide_fame_auto = jax.jit(
-    decide_fame_auto_impl, static_argnums=(0, 2), donate_argnums=(1,)
+    decide_fame_auto_impl, static_argnums=(0, 2, 3), donate_argnums=(1,)
 )

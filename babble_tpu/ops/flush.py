@@ -1,0 +1,267 @@
+"""The fused live-flush program: incremental ingest + windowed fame +
+windowed order in ONE compiled kernel with donated device state.
+
+This is the streaming-incremental half of ROADMAP item 3.  The legacy
+("throughput") surface runs three separate programs per flush — ingest,
+then DecideFame over ALL r_cap round rows ([R, N, N] witness tensors
+re-gathered every call), then DecideRoundReceived scanning ALL r_cap
+rounds against the full [E+1, N] fd tensor — so per-flush cost grows
+with DAG size even when one gossip sync added eight events.  The
+reference avoids exactly this with its rolling caches
+(hashgraph/caches.go:45-76): consensus work per sync is proportional to
+*new* events.  This module is the dense twin of that idea:
+
+- **State stays resident.**  The DagState rides through as a donated
+  buffer (the ``donate_argnums`` discipline of ops/ingest.py applied to
+  the whole pipeline); nothing round-trips to host between phases.
+- **Fame/order resume from persisted frontiers.**  ``state.lcr`` is the
+  order frontier (every decided round <= lcr has been reception-scanned
+  exactly once — reception sets are frozen at decision time, see
+  ``order_window_impl``) and ``state.max_round`` bounds the undecided
+  window, so both phases operate on a W-round dynamic slice starting at
+  lcr+1 instead of re-deriving from genesis.  W is a small static
+  bucket chosen by the engine from its host mirrors (live DAGs keep
+  2-4 rounds open), so a stream of gossip-sized flushes shares ONE
+  compiled program.
+- **Witness-set finality gate.**  Fame decisions are gated on
+  ``head_round_min_math`` (the fused twin of ops/wide.py
+  ``complete=False``), fixing the premature intra-round finality defect
+  on the live path: a round's famous set — and therefore its prn
+  whitening and cts medians — freezes only once every chain's head
+  round has passed it.
+
+Shape bucketing: one program per (cfg, W, kpad, tpad, bpad).  The
+engine records compiled shape keys in the AOT manifest (ops/aot.py) so
+a restart can pre-compile them against the persistent XLA cache.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .fame import (
+    F32,
+    FAME_FALSE,
+    FAME_TRUE,
+    FAME_UNDEFINED,
+    _lcr_candidates,
+)
+from .ingest import EventBatch, ingest_coords_impl, ingest_rounds_impl
+from .order import order_median_rows, order_undetermined
+from .state import (
+    DagConfig,
+    DagState,
+    I32,
+    head_round_min_math,
+    sanitize,
+)
+
+#: latency-kernel round-window buckets: W is rounded up to one of these
+#: so a live stream (2-4 open rounds) shares one compiled program
+W_BUCKETS = (4, 8, 16)
+W_MAX = W_BUCKETS[-1]
+
+
+def bucket_w(active_rounds: int, r_cap: int) -> int:
+    """Smallest W bucket covering ``active_rounds`` open rounds, or 0
+    when no latency bucket fits (the engine falls back to the
+    throughput kernels)."""
+    for w in W_BUCKETS:
+        if active_rounds <= w and w <= r_cap:
+            return w
+    return 0
+
+
+def fame_window_impl(
+    cfg: DagConfig, W: int, state: DagState, gate: bool
+) -> DagState:
+    """Diagonal-scan fame voting over the W-round window starting at
+    lcr+1 — the same recursion as fame.decide_fame_impl with the round
+    axis sliced to the open window, so the [W, N, N] witness tensors
+    replace the [R, N, N] full-table gathers.  Rounds above the window
+    (max_round ran past the engine's W estimate) simply stay undecided
+    until the next flush re-centers the window; fame decisions are
+    sticky and votes are recomputed from insert-frozen coordinates, so
+    deferral never changes a decision."""
+    n, sm = cfg.n, cfg.super_majority
+    R = cfg.r_cap
+
+    z = jnp.zeros((), I32)
+    lo = jnp.clip(state.lcr + 1 - state.r_off, 0, max(R - W, 0))
+    wsl = jax.lax.dynamic_slice(state.wslot, (lo, z), (W, n))
+    valid_w = wsl >= 0
+    ws = sanitize(wsl, cfg.e_cap)
+    law = state.la[ws]                                 # [W, N, N]
+    fdw = state.fd[ws]                                 # [W, N, N]
+    seqw = state.seq[ws]                               # [W, N]
+    mbw = state.mbit[ws]                               # bool[W, N]
+    famous_w = jax.lax.dynamic_slice(state.famous, (lo, z), (W, n))
+
+    law_next = jnp.concatenate(
+        [law[1:], jnp.full((1, n, n), -1, law.dtype)], axis=0
+    )
+    valid_next = jnp.concatenate(
+        [valid_w[1:], jnp.zeros((1, n), bool)], axis=0
+    )
+
+    ss_cnt = (law_next[:, :, None, :] >= fdw[:, None, :, :]).sum(-1)
+    ss_next = (
+        (ss_cnt >= sm) & valid_next[:, :, None] & valid_w[:, None, :]
+    ).astype(F32)
+    tot_next = ss_next.sum(-1)                         # f32[W, N]
+    see_next = (
+        (law_next >= seqw[:, None, :])
+        & valid_next[:, :, None]
+        & valid_w[:, None, :]
+    ).astype(F32)
+
+    zpad3 = jnp.zeros((W, n, n), F32)
+    ss_pad = jnp.concatenate([ss_next, zpad3], axis=0)        # [2W, N, N]
+    tot_pad = jnp.concatenate([tot_next, jnp.zeros((W, n), F32)], axis=0)
+    mb_pad = jnp.concatenate([mbw, jnp.zeros((W, n), bool)], axis=0)
+
+    # window row i holds absolute round lo + i + r_off
+    i_idx = jnp.arange(W, dtype=I32) + lo + state.r_off
+    in_window = (i_idx > state.lcr) & (i_idx < state.max_round)
+    if gate:
+        in_window = in_window & (i_idx <= head_round_min_math(cfg, state))
+
+    def step(d, carry):
+        votes, famous = carry
+        d = jnp.asarray(d, I32)
+        can_vote = (i_idx + d) <= state.max_round             # [W]
+
+        ss_d = jax.lax.dynamic_slice(ss_pad, (d - 1, z, z), (W, n, n))
+        tot_d = jax.lax.dynamic_slice(tot_pad, (d - 1, z), (W, n))
+        mb_d = jax.lax.dynamic_slice(mb_pad, (d, z), (W, n))
+
+        yays = jnp.einsum(
+            "iyw,iwx->iyx", ss_d, votes, preferred_element_type=F32
+        )
+        nays = tot_d[:, :, None] - yays
+        v = yays >= nays
+        t = jnp.maximum(yays, nays)
+        strong = t >= sm
+
+        undecided = (famous == FAME_UNDEFINED) & valid_w & in_window[:, None]
+        normal = (d % cfg.active_n) != 0
+
+        deciding = strong & normal & can_vote[:, None, None]
+        decide_x = deciding.any(axis=1)
+        v_star = (deciding & v).any(axis=1)
+        famous = jnp.where(
+            undecided & decide_x,
+            jnp.where(v_star, FAME_TRUE, FAME_FALSE).astype(jnp.int8),
+            famous,
+        )
+
+        coin_vote = jnp.where(strong, v, mb_d[:, :, None])
+        new_votes = jnp.where(normal, v, coin_vote).astype(F32)
+        votes = jnp.where(can_vote[:, None, None], new_votes, votes)
+        return votes, famous
+
+    d_max = jnp.minimum(
+        jnp.maximum(state.max_round - jnp.maximum(state.lcr, -1), 2), W
+    )
+    votes, famous_w = jax.lax.fori_loop(
+        2, d_max + 1, step, (see_next, famous_w)
+    )
+
+    decided_round = ((~valid_w) | (famous_w != FAME_UNDEFINED)).all(axis=1)
+    has_w = valid_w.any(axis=1)
+    # gated: contiguous-prefix advance (fame._lcr_candidates) — rounds
+    # the window doesn't cover are above max_round-1 or beyond the
+    # gate, so the window always contains the first failing round
+    cand = _lcr_candidates(state, i_idx, in_window, decided_round,
+                           has_w, gate)
+    new_lcr = jnp.max(jnp.where(cand, i_idx, -1))
+    lcr = jnp.maximum(state.lcr, new_lcr)
+
+    famous_out = jax.lax.dynamic_update_slice(state.famous, famous_w, (lo, z))
+    return state._replace(famous=famous_out, lcr=lcr)
+
+
+def order_window_impl(
+    cfg: DagConfig, W: int, state: DagState, lcr_prev: jnp.ndarray
+) -> DagState:
+    """Round-received + consensus timestamps over the W-round window
+    starting at lcr_prev+1 — the only rounds that can newly receive
+    events this flush.
+
+    Exactly-once soundness (why the frontier replaces the full R-round
+    rescan bit-for-bit):
+
+    - every decided round is <= lcr (lcr is the max over decided
+      rounds), so rounds newly decided this call lie in
+      (lcr_prev, lcr_new] — inside the window;
+    - a round's reception set is frozen at decision time: see(w, x)
+      needs x's first descendant on w's chain at seq <= seq(w), and
+      once w is inserted its chain prefix is complete, so fd[x, c_w]
+      can only ever gain values ABOVE seq(w) — no event (present or
+      late-arriving) can start being seen after the round decided.
+      Rounds <= lcr_prev were scanned when they decided; rescanning
+      them is the identity, so the window skips them.
+    """
+    n, e1 = cfg.n, cfg.e_cap + 1
+    R = cfg.r_cap
+
+    z = jnp.zeros((), I32)
+    lo = jnp.clip(lcr_prev + 1 - state.r_off, 0, max(R - W, 0))
+    wsl = jax.lax.dynamic_slice(state.wslot, (lo, z), (W, n))
+    valid_w = wsl >= 0
+    ws = sanitize(wsl, cfg.e_cap)
+    seqw = state.seq[ws]                                   # [W, N]
+    fam_tab = jax.lax.dynamic_slice(state.famous, (lo, z), (W, n))
+    fam = (fam_tab == FAME_TRUE) & valid_w                 # [W, N]
+    decided = ((~valid_w) | (fam_tab != FAME_UNDEFINED)).all(axis=1)
+    has_w = valid_w.any(axis=1)
+    fam_cnt = fam.sum(axis=1)                              # [W]
+
+    und = order_undetermined(cfg, state)
+    i_abs0 = lo + state.r_off
+
+    def step(i, rr):
+        i_abs = i_abs0 + i
+        active = (
+            decided[i] & has_w[i] & (i_abs <= state.max_round)
+            & (i_abs <= state.lcr)
+        )
+        sees = fam[i][None, :] & (state.fd <= seqw[i][None, :])  # [E+1, N]
+        c = sees.sum(axis=1)
+        cond = (
+            und
+            & (rr == -1)
+            & (i_abs > state.round)
+            & active
+            & (c > fam_cnt[i] // 2)
+        )
+        return jnp.where(cond, i_abs, rr)
+
+    rr = jax.lax.fori_loop(0, W, step, state.rr)
+    newly = und & (rr != -1)
+
+    i_of = jnp.clip(rr - i_abs0, 0, W - 1)
+    med = order_median_rows(cfg, state, seqw, fam, state.fd, i_of)
+    cts = jnp.where(newly, med, state.cts)
+    return state._replace(rr=rr, cts=cts)
+
+
+def live_flush_impl(
+    cfg: DagConfig, W: int, gate: bool, state: DagState, batch: EventBatch
+) -> DagState:
+    """One live flush end to end: incremental ingest (coords + rounds)
+    then windowed fame and order, all inside one program so the state
+    never leaves the device between phases.  ``batch`` may be empty
+    (k=0, the drain call when gossip stops): the ingest phases are
+    no-ops on padded lanes and fame/order still advance."""
+    state = ingest_coords_impl(cfg, state, "incremental", batch)
+    state = ingest_rounds_impl(cfg, state, "incremental", batch)
+    lcr_prev = state.lcr
+    state = fame_window_impl(cfg, W, state, gate)
+    return order_window_impl(cfg, W, state, lcr_prev)
+
+
+live_flush = jax.jit(
+    live_flush_impl, static_argnums=(0, 1, 2), donate_argnums=(3,)
+)

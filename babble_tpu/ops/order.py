@@ -60,7 +60,15 @@ def order_rr_round(cfg, state, tables, und, i, rr):
     # table row i holds absolute round i_abs (rolling round window);
     # i_abs >= 1 is implied by i_abs > round(x) >= 0 for valid events
     i_abs = i + state.r_off
-    active = decided[i] & has_w[i] & (i_abs <= state.max_round)
+    # i_abs <= lcr: under the reference's max-jump lcr this is implied
+    # (any decided round with witnesses is an lcr candidate), and under
+    # the live engine's gated CONTIGUOUS lcr it is the stop-at-first-
+    # undecided-round rule that makes round-received assignment
+    # identical across nodes (fame._lcr_candidates)
+    active = (
+        decided[i] & has_w[i] & (i_abs <= state.max_round)
+        & (i_abs <= state.lcr)
+    )
     sees = fam[i][None, :] & (state.fd <= seqw[i][None, :])      # [E+1, N]
     c = sees.sum(axis=1)
     cond = (
@@ -145,6 +153,23 @@ def order_median_rows(cfg, state, seqw, fam, fd_rows, i_rows):
     n = cfg.n
     cej = state.ce[:n]                                     # [N, S+1]
     ts_grid = state.ts[sanitize(cej, cfg.e_cap)]           # i64[N, S+1]
+    if cfg.ts32:
+        # Narrow the median working set to i32 (the order phase is 94%
+        # HBM-bound and tv + its sort double are its largest tensors):
+        # rebase against the minimum LIVE timestamp — a constant shift
+        # preserves sort order, so the median is bit-identical to the
+        # i64 path while the live span fits int32 (state.ts32_ok; the
+        # engine enforces the span guard host-side before every flush).
+        valid_e = (
+            (jnp.arange(cfg.e_cap + 1) < state.n_events) & (state.seq >= 0)
+        )
+        ts_base = jnp.min(jnp.where(valid_e, state.ts, INT64_MAX))
+        ts_base = jnp.minimum(ts_base, INT64_MAX - 1)      # empty-DAG guard
+        ts_grid = jnp.clip(ts_grid - ts_base, 0, INT32_MAX).astype(I32)
+        tmax = jnp.asarray(INT32_MAX, I32)
+    else:
+        ts_base = None
+        tmax = jnp.asarray(INT64_MAX, state.ts.dtype)
     select_accumulate = jax.default_backend() == "tpu" and cfg.s_cap < 2048
 
     rows = fd_rows.shape[0]
@@ -161,16 +186,25 @@ def order_median_rows(cfg, state, seqw, fam, fd_rows, i_rows):
 
         tv = jax.lax.fori_loop(
             0, cfg.s_cap + 1, acc_step,
-            jnp.full((rows, n), INT64_MAX, dtype=state.ts.dtype),
+            jnp.full((rows, n), tmax, dtype=ts_grid.dtype),
         )
     else:
         # long chains (select cost scales with S: 34.7 s vs 6.7 s at
         # 256x1M, S=4106) and CPU backends: the real gather wins
         tv = ts_grid[jnp.arange(n)[None, :], fdc]
-    tv = jnp.where(sees_rows, tv, INT64_MAX)
+    tv = jnp.where(sees_rows, tv, tmax)
     tv_sorted = jnp.sort(tv, axis=1)
     cnt_s = sees_rows.sum(axis=1)
-    return tv_sorted[jnp.arange(rows), jnp.clip(cnt_s // 2, 0, n - 1)]
+    med = tv_sorted[jnp.arange(rows), jnp.clip(cnt_s // 2, 0, n - 1)]
+    if cfg.ts32:
+        # widen back: sentinel medians (no seer) stay INT64_MAX like
+        # the i64 path (such rows are never newly-received — reception
+        # requires at least one famous seer — so cts never reads them)
+        med = jnp.where(
+            med == INT32_MAX, INT64_MAX,
+            med.astype(state.ts.dtype) + ts_base,
+        )
+    return med
 
 
 decide_order = jax.jit(decide_order_impl, static_argnums=(0,), donate_argnums=(1,))

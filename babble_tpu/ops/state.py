@@ -54,7 +54,17 @@ class DagConfig(NamedTuple):
     at 10k x 100k in i32), and every value is a per-creator seq, bounded
     by s_cap.  Halving them is what fits the deep 10k-participant
     configs on one 16 GB chip.  Requires s_cap < 16384 (headroom below
-    the int16 INF sentinel); coord16_ok() checks."""
+    the int16 INF sentinel); coord16_ok() checks.
+
+    ``ts32`` narrows the ORDER phase's median working set (the i64
+    ``tv`` tensor and its sort double, the HBM-bound tail of the
+    94%-of-peak order kernel) to int32 by rebasing every timestamp
+    against the minimum live timestamp inside the kernel.  Sorting is
+    order-preserving under a constant shift, so medians are
+    bit-identical to the i64 path whenever the live timestamp SPAN
+    fits int32 (ts32_ok) — true for logical clocks (sim, chaos, bench
+    streams), never for wall-clock ns fleets, which keep i64.  The
+    engine enforces the span guard host-side before every flush."""
 
     n: int          # participants (array width, possibly mesh-padded)
     e_cap: int      # event slot capacity
@@ -63,6 +73,7 @@ class DagConfig(NamedTuple):
     n_real: int = 0
     coord16: bool = False
     coord8: bool = False     # overrides coord16 (shallowest chains only)
+    ts32: bool = False       # i32 relative timestamps in the order median
 
     @property
     def active_n(self) -> int:
@@ -91,6 +102,12 @@ def coord16_ok(s_cap: int) -> bool:
     """int16 coordinates are exact when every seq (plus slack for the
     +1-ish arithmetic in the kernels) stays clear of the INF sentinel."""
     return s_cap < (1 << 14)
+
+
+def ts32_ok(ts_min: int, ts_max: int) -> bool:
+    """int32 relative timestamps are exact when the live span (plus a
+    little slack for the sentinel) stays clear of INT32_MAX."""
+    return (ts_max - ts_min) < (1 << 31) - 4
 
 
 def coord8_ok(s_cap: int) -> bool:
@@ -292,6 +309,43 @@ def compact_impl(
 
 
 compact = jax.jit(compact_impl, static_argnums=(0,), donate_argnums=(1,))
+
+
+#: staleness horizon (rounds) for the live finality gate: a chain whose
+#: head is this many rounds behind max_round stops blocking decisions.
+#: Sound under partial synchrony: a chain that falls K rounds behind and
+#: later catches up never produces witnesses for the skipped rounds (its
+#: next event's round jumps to ~max_round via the fresh other-parent),
+#: so the only divergence risk the horizon admits is a witness already
+#: IN FLIGHT for K+ rounds of fleet progress — the explicit propagation
+#: assumption that replaces the pre-PR implicit one of zero rounds.
+HEAD_GATE_HORIZON = 8
+
+
+def head_round_min_math(cfg: DagConfig, state: DagState) -> jnp.ndarray:
+    """Effective head-round minimum for the live witness-set finality
+    gate: the smallest chain-head round over minted, NON-STALE chains
+    (-1 while any live-ish participant has never minted).
+
+    Rounds are monotone along a chain and a round-r witness is the
+    FIRST chain event of round r, so round i's witness set is final
+    once every chain's head round has reached i — the gate the wide
+    pipeline decides behind (ops/wide.py _head_round_min).  Ported
+    verbatim that gate has all-N liveness: one crashed or partitioned
+    peer freezes commitment (and therefore eviction and fast-forward
+    recovery) fleet-wide forever.  The live twin adds the
+    HEAD_GATE_HORIZON: a chain more than K rounds behind max_round is
+    excluded from the minimum, so the fleet resumes committing K
+    rounds after a peer goes dark, while the slow-but-live peers the
+    gate exists for (chaos slow-peer: delays of a round or two) keep
+    blocking decisions exactly as the strict gate would."""
+    n = cfg.active_n
+    cnt_w = state.cnt[:n] - state.s_off[:n]
+    heads = state.ce[jnp.arange(n), jnp.clip(cnt_w - 1, 0, cfg.s_cap)]
+    hr = state.round[sanitize(jnp.where(cnt_w > 0, heads, -1), cfg.e_cap)]
+    hr = jnp.where(state.cnt[:n] > 0, hr, -1)
+    stale = hr + HEAD_GATE_HORIZON < state.max_round
+    return jnp.min(jnp.where(stale, INT32_MAX, hr))
 
 
 def bucket(x: int, minimum: int = 8) -> int:

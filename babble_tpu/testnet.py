@@ -106,6 +106,12 @@ class TestnetRunner:
     #: the fleet with --no_pipeline/--no_eager_gossip — the lockstep
     #: reference shape, the ingress bench's A/B baseline
     pipeline: bool = True
+    #: AOT prewarm at node boot (ops/aot.py): every node replays the
+    #: shared jax_cache dir's shape manifest through lower().compile()
+    #: before its first flush, so a fleet RESTART reaches consensus in
+    #: seconds instead of re-paying the compile storm.  False passes
+    #: --no_aot_prewarm (the persistent jit cache still applies).
+    aot: bool = True
     # N processes sharing one host must not fight over a single accelerator;
     # set to "" to let each node pick its own default platform.
     jax_platform: str = "cpu"
@@ -154,6 +160,8 @@ class TestnetRunner:
                      "--wal_fsync", "batch(32,50)"]
         if not self.pipeline:
             args += ["--no_pipeline", "--no_eager_gossip"]
+        if not self.aot:
+            args.append("--no_aot_prewarm")
         if not self.with_clients:
             args.append("--no_client")
         return args

@@ -42,6 +42,25 @@ clean close and after every checkpoint prune.  The receipt lets a
 restart distinguish "WAL legitimately empty (just pruned / clean
 shutdown)" from "WAL missing entirely" — only the latter falls back to
 the peer-negotiated seq skip-ahead probe.
+
+**Per-record commit markers** (``fsync=always`` only): after each
+record's fsync returns, a 8-byte marker frame ``[u32 0][u32 crc]``
+(zero length = marker; crc = the committed record's payload crc) is
+buffered behind it — durable by the NEXT record's fsync.  The marker
+is in-file proof of the always discipline: append fsyncs before the
+event can gossip, so a recovered log whose records are all
+marker-confirmed except at most the final one can only have lost the
+in-flight record nobody ever saw.  Recovery therefore skips the peer
+seq probe for such torn tails (``needs_probe``) — closing the PR-5
+leftover where every truncation armed the probe even under
+``always``.  Markers only prove a PREFIX, though: a later batch/off
+incarnation's buffered suffix can vanish without a trace, so every
+probe-skip arm additionally requires the durable ``policy`` stamp
+each incarnation fsyncs at open to say the PREVIOUS one ran
+``always``.  The one window that remains: bit rot landing exactly on
+the final, acked-but-unmarked record is indistinguishable from an
+in-flight tear — unless its marker already made it to disk, which
+recovery does check.
 """
 
 from __future__ import annotations
@@ -65,6 +84,13 @@ MAX_RECORD = 1 << 24
 
 _SEG_RE = re.compile(r"^seg-(\d{8})\.wal$")
 _RECEIPT = "head.receipt"
+#: fsync policy of the CURRENT incarnation, written (fsynced) at open:
+#: recovery must know what the PREVIOUS incarnation actually ran —
+#: commit markers prove some PREFIX was written under `always`, but a
+#: later batch/off incarnation's buffered suffix can vanish without a
+#: trace, so the probe-skip arms require this durable policy evidence,
+#: never the current config or the markers alone
+_POLICY = "policy"
 #: present only between a graceful close and the next open — its
 #: absence at boot means the previous incarnation crashed, and under a
 #: batched fsync policy a crash can lose a whole SUFFIX of records
@@ -110,10 +136,11 @@ class FsyncPolicy:
         return self.mode
 
 
-def _pack_record(ev: Event) -> bytes:
+def _pack_record(ev: Event) -> Tuple[bytes, int]:
     payload = msgpack.packb(FullWireEvent.from_event(ev).pack(),
                             use_bin_type=True)
-    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+    crc = zlib.crc32(payload)
+    return _HDR.pack(len(payload), crc) + payload, crc
 
 
 class WriteAheadLog:
@@ -143,6 +170,10 @@ class WriteAheadLog:
         self._bind_metrics(registry if registry is not None else Registry())
 
         os.makedirs(path, exist_ok=True)
+        # previous incarnation's fsync policy (see _POLICY), then stamp
+        # our own before any append can land
+        self._prev_always = self._read_policy() == "always"
+        self._write_policy()
         self.receipt: Optional[Tuple[int, str]] = self._read_receipt()
         clean_path = os.path.join(path, _CLEAN)
         self.had_clean_close = os.path.isfile(clean_path)
@@ -150,6 +181,11 @@ class WriteAheadLog:
             os.remove(clean_path)   # we are the running incarnation now
         self.recovered_events: List[Event] = []
         self.truncated_records = 0
+        #: commit-marker recovery state (fsync=always discipline):
+        #: per-record confirmation flags, and whether the truncation —
+        #: if any — is provably an unacked in-flight tear
+        self._marked_flags: List[bool] = []
+        self._torn_tail_safe = False
         self._seg_index = self._scan()
         self._m_truncated.inc(self.truncated_records)
 
@@ -193,6 +229,25 @@ class WriteAheadLog:
         return not self.recovered_events and self.receipt is None
 
     @property
+    def marker_disciplined(self) -> bool:
+        """True when the recovered log carries in-file proof of the
+        ``fsync=always`` commit-marker discipline: at least one marker,
+        and every record except possibly the FINAL one confirmed (the
+        final record's marker rides the next append's fsync, so a crash
+        may legitimately lose exactly that one marker).
+
+        Markers alone only prove some PREFIX was appended under
+        ``always`` — a later batch/off incarnation's entire buffered
+        suffix can vanish with no trace on disk — so every probe-skip
+        arm pairs this with ``_prev_always`` (the fsynced policy stamp
+        the previous incarnation wrote at ITS open)."""
+        if not self._marked_flags:
+            return False
+        if not any(self._marked_flags):
+            return False
+        return all(self._marked_flags[:-1])
+
+    @property
     def needs_probe(self) -> bool:
         """True when recovery cannot vouch that every PUBLISHED seq
         survived, so minting must wait for the peer-negotiated
@@ -200,16 +255,57 @@ class WriteAheadLog:
         torn/corrupt, or the previous incarnation crashed under a
         batched/disabled fsync policy — there a whole suffix of
         records can be lost at a clean fsync boundary with nothing
-        left to detect.  ``fsync=always`` is exempt on the last arm:
-        every append fsyncs before the event can gossip, so only the
-        in-flight record can be lost (the torn-tail arm catches it)."""
-        if self.is_fresh or self.truncated_records > 0:
+        left to detect.
+
+        ``fsync=always`` logs carry per-record commit markers, and a
+        truncation that is provably an unacked in-flight tear — the
+        previous incarnation's policy stamp says ``always``, marker
+        discipline intact, damage confined to the unmarked tail of the
+        final segment, nothing decodable beyond it — skips the probe:
+        append fsynced before the event could gossip, so the lost
+        record was never published.  The unclean-shutdown arm likewise
+        trusts the previous incarnation's STAMPED policy, never the
+        current config (which says nothing about what the dead process
+        ran) and never the markers alone (which only prove a prefix)."""
+        if self.is_fresh:
             return True
-        return self.policy.mode != "always" and not self.had_clean_close
+        if self.truncated_records > 0:
+            return not (self._prev_always and self._torn_tail_safe
+                        and self.marker_disciplined)
+        if self._prev_always and (self.marker_disciplined
+                                  or not self.recovered_events):
+            # the stamp alone is not enough: recovered records must
+            # also SHOW the always discipline (an earlier batch-era
+            # tail that vanished at a clean EOF leaves unmarked
+            # records behind — those seqs were published unvouched).
+            # An empty-but-receipted log is fine: under always, any
+            # post-prune append would have been fsynced and present.
+            return False
+        return not self.had_clean_close
 
     @property
     def receipt_seq(self) -> int:
         return self.receipt[0] if self.receipt is not None else -1
+
+    def _read_policy(self) -> Optional[str]:
+        try:
+            with open(os.path.join(self.dir, _POLICY)) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    def _write_policy(self) -> None:
+        try:
+            tmp = os.path.join(self.dir, _POLICY + ".tmp")
+            with open(tmp, "w") as f:
+                f.write(self.policy.mode)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.dir, _POLICY))
+        except OSError:
+            # a read-only dir only loses the NEXT boot's probe-skip
+            # evidence — recovery then stays conservative (probes)
+            pass
 
     def _read_receipt(self) -> Optional[Tuple[int, str]]:
         try:
@@ -255,39 +351,130 @@ class WriteAheadLog:
             # point plus every decodable record in the discarded
             # segments (an operator triaging disk rot must not see a
             # hundred-record loss reported as 1).
-            self.truncated_records += 1
             with open(seg_path, "r+b") as f:
                 f.truncate(good)
+            discarded = 0
             for _, later in segs[si + 1:]:
                 with open(later, "rb") as f:
-                    self.truncated_records += self._count_records(f.read())
+                    discarded += self._count_records(f.read())
                 os.remove(later)
+            # ...and conversely must not see "1 record lost" when the
+            # damaged frame is a trailing commit MARKER whose record
+            # was recovered intact: a bad or torn zero-length frame at
+            # the very tail (nothing decodable beyond, no later
+            # segments) lost no event data at all.  The tell apart
+            # from a torn in-flight RECORD: markers directly follow
+            # their record, so a torn marker leaves the final
+            # recovered record UNMARKED, while a torn record leaves it
+            # marked (and stays counted, as before).
+            frag = len(data) - good
+            bad_is_marker_frame = (
+                frag < _HDR.size
+                or (_HDR.unpack_from(data, good)[0] == 0
+                    and self._count_records(data[good + _HDR.size:]) == 0)
+            )
+            marker_only_tear = (
+                si == len(segs) - 1
+                and discarded == 0
+                and bad_is_marker_frame
+                # ...and the log must actually show marker discipline
+                # with the final record awaiting its marker — a
+                # zero-FILL tail on a marker-less batch/off log stays
+                # conservatively counted as one possibly-lost record
+                and any(self._marked_flags)
+                and not self._marked_flags[-1]
+            )
+            if not marker_only_tear:
+                self.truncated_records += 1
+            self.truncated_records += discarded
+            # unacked-in-flight-tear classification (needs_probe):
+            # damage confined to the final segment's tail, nothing
+            # decodable beyond the corruption point, and no marker
+            # vouching that the damaged record was ever acked
+            self._torn_tail_safe = (
+                si == len(segs) - 1
+                and discarded == 0
+                and self._tail_is_unacked_tear(data, good)
+            )
             break
         return next_index
 
     @staticmethod
     def _count_records(data: bytes) -> int:
-        """Whole records in a segment being discarded (count only)."""
-        off, n, count = 0, len(data), 0
+        """Whole records in a segment being discarded (count only).
+        Zero-length commit-marker frames are skipped, not counted —
+        but markers never appear back to back (record, marker, record,
+        ...), so a SECOND consecutive zero frame is zero fill and ends
+        the walk (a largely zero-filled 4 MB segment must not cost
+        half a million header parses at recovery)."""
+        off, n, count, zrun = 0, len(data), 0, 0
         while off + _HDR.size <= n:
             length, _ = _HDR.unpack_from(data, off)
-            if length == 0 or length > MAX_RECORD or off + _HDR.size + length > n:
+            if length == 0:
+                zrun += 1
+                if zrun >= 2:
+                    break           # zero fill, nothing decodable follows
+                off += _HDR.size    # a (plausible) commit marker
+                continue
+            zrun = 0
+            if length > MAX_RECORD or off + _HDR.size + length > n:
                 break
             count += 1
             off += _HDR.size + length
         return count
 
+    @staticmethod
+    def _tail_is_unacked_tear(data: bytes, off: int) -> bool:
+        """True when the bad region at ``off`` can only be the record
+        that was in flight when the process died: a torn header or
+        payload at EOF, or a whole-but-corrupt final frame with NO
+        commit marker behind it (a marker would prove the record was
+        fsynced-and-acked — bit rot on durable history, not a tear)."""
+        n = len(data)
+        if n - off < _HDR.size:
+            return True             # torn header at EOF
+        length, _ = _HDR.unpack_from(data, off)
+        if length == 0:
+            # a corrupt MARKER frame: its record was already recovered,
+            # but whether later records existed is unknowable — probe
+            return False
+        if length > MAX_RECORD:
+            # garbage length (zero-fill / rot): safe only when nothing
+            # decodable follows the corruption point
+            return WriteAheadLog._count_records(data[off:]) == 0
+        end = off + _HDR.size + length
+        if end > n:
+            return True             # torn payload at EOF
+        # whole frame, bad crc / undecodable: if a commit marker
+        # follows, the record was acked before the crash — rot, probe
+        return not (
+            n - end >= _HDR.size and _HDR.unpack_from(data, end)[0] == 0
+        )
+
     def _scan_segment(self, data: bytes) -> Optional[int]:
-        """Decode records from one segment into ``recovered_events``.
-        Returns None if the whole segment was clean, else the byte
-        offset of the first bad record (the truncation point)."""
+        """Decode records from one segment into ``recovered_events``
+        (zero-length frames are commit markers confirming the record
+        immediately before them).  Returns None if the whole segment
+        was clean, else the byte offset of the first bad frame (the
+        truncation point)."""
         off = 0
         n = len(data)
+        last_crc: Optional[int] = None   # unconfirmed previous record
         while off < n:
             if n - off < _HDR.size:
                 return off          # torn header
             length, crc = _HDR.unpack_from(data, off)
-            if length == 0 or length > MAX_RECORD or off + _HDR.size + length > n:
+            if length == 0:
+                # commit marker: must confirm the immediately-previous
+                # record by payload crc, exactly once — anything else
+                # (orphan marker, wrong crc, duplicate) is corruption
+                if last_crc is None or crc != last_crc:
+                    return off
+                self._marked_flags[-1] = True
+                last_crc = None
+                off += _HDR.size
+                continue
+            if length > MAX_RECORD or off + _HDR.size + length > n:
                 return off          # zero-fill / garbage length / torn payload
             payload = data[off + _HDR.size: off + _HDR.size + length]
             if zlib.crc32(payload) != crc:
@@ -299,6 +486,8 @@ class WriteAheadLog:
             except Exception:
                 return off          # CRC-valid but undecodable payload
             self.recovered_events.append(ev)
+            self._marked_flags.append(False)
+            last_crc = crc
             off += _HDR.size + length
         return None
 
@@ -313,12 +502,20 @@ class WriteAheadLog:
         ``wal-before-gossip`` pins it at the mint sites)."""
         if self._closed:
             raise ValueError("write-ahead log is closed")
-        buf = _pack_record(event)
+        buf, crc = _pack_record(event)
         self._active.write(buf)
         self._size += len(buf)
         self._pending += 1
         self._m_appended.inc()
         self._sync_per_policy()
+        if self.policy.mode == "always":
+            # commit marker: the fsync above returned, so this record is
+            # durable BEFORE the event can gossip — the marker (durable
+            # by the next append's fsync) is the in-file proof recovery
+            # needs to skip the seq probe on a torn in-flight tail
+            self._active.write(_HDR.pack(0, crc))
+            self._size += _HDR.size
+            self._active.flush()
         if self._size >= self.segment_bytes:
             self._rotate()
 

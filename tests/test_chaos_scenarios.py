@@ -195,14 +195,14 @@ def test_result_dict_is_json_shaped():
 #: real engine defect (see the matching ROADMAP open item).  They are
 #: xfail-strict — when the engine is fixed, the xpass flips the test
 #: and the entry must be removed.
-KNOWN_ENGINE_DEFECTS = {
-    ("slow-peer", 1):
-        "premature intra-round finality: the fused live engine commits "
-        "a round's intra-round order (prn whitening + cts medians) "
-        "before all of that round's witnesses arrived, so honest nodes "
-        "permute events 52-54 under asymmetric delay — ROADMAP "
-        "'premature intra-round finality'",
-}
+#:
+#: (The premature-intra-round-finality entry — slow-peer seed 1,
+#: permuted events 52-54 — was removed by ISSUE 7: the live engine now
+#: gates fame decisions on witness-set finality and advances lcr over
+#: the contiguous decided prefix, so round-received cohorts are
+#: identical across nodes; see ops/fame._lcr_candidates and
+#: ops/state.head_round_min_math.)
+KNOWN_ENGINE_DEFECTS: dict = {}
 
 
 @pytest.mark.slow

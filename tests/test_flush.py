@@ -1,0 +1,307 @@
+"""Streaming incremental engine (ISSUE 7): the acceptance pins.
+
+- **Incremental-vs-full bit parity**: the fused latency kernel
+  (windowed fame/order over persisted frontiers, one program per
+  flush) commits the SAME order as the legacy throughput phases on the
+  same flush sequence — across seeds, gated and ungated, and on the
+  chaos runner's fingerprint surface (flaky-link / slow-peer minis).
+- **Compile-count regression**: a stream of same-shape flushes
+  triggers ZERO recompiles (counted via the jax.monitoring compilation
+  hook ops/aot.py installs).
+- **AOT compile cache**: prewarm fills the engine's executable map
+  from the shape manifest; prewarmed flushes trace nothing.
+- **Witness-set finality gate**: a round's fame defers until every
+  chain's head round passed it, then decides identically.
+- **ts32**: i32 relative-timestamp medians are bit-identical to i64.
+"""
+
+import numpy as np
+import pytest
+
+from babble_tpu.consensus.engine import TpuHashgraph
+from babble_tpu.ops import aot
+from babble_tpu.sim import random_gossip_dag
+
+
+def _stream(dag, chunk, **kw):
+    """Feed a sim DAG through an engine in ``chunk``-sized flushes;
+    returns (engine, committed hex ids in commit order)."""
+    eng = TpuHashgraph(dag.participants, verify_signatures=False, **kw)
+    out = []
+    for i, ev in enumerate(dag.events):
+        eng.insert_event(ev.clone())
+        if (i + 1) % chunk == 0:
+            out += [e.hex() for e in eng.run_consensus()]
+    out += [e.hex() for e in eng.run_consensus()]
+    return eng, out
+
+
+# ----------------------------------------------------------------------
+# incremental-vs-full bit parity
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("gate", [False, True])
+def test_latency_throughput_parity(seed, gate):
+    """The kernel split's contract: both compiled surfaces produce
+    bit-identical committed order (and identical engine observables)
+    on the same flush sequence."""
+    dag = random_gossip_dag(4, 200, seed=seed)
+    e_lat, o_lat = _stream(dag, 8, kernel_class="latency",
+                           finality_gate=gate)
+    e_thr, o_thr = _stream(dag, 8, kernel_class="throughput",
+                           finality_gate=gate)
+    assert e_lat.last_kernel_class == "latency"
+    assert e_thr.last_kernel_class == "throughput"
+    assert o_lat == o_thr
+    assert e_lat.consensus_events() == e_thr.consensus_events()
+    assert e_lat.last_consensus_round == e_thr.last_consensus_round
+    for f in ("rr", "round", "cts"):
+        a = np.asarray(getattr(e_lat.state, f))
+        b = np.asarray(getattr(e_thr.state, f))
+        assert (a == b).all(), f"{f} diverged between kernel classes"
+
+
+def test_auto_dispatch_picks_latency_for_gossip_flushes():
+    """kernel_class=auto routes gossip-sized flushes to the fused
+    latency program and stays bit-identical to the pinned paths."""
+    dag = random_gossip_dag(4, 150, seed=5)
+    e_auto, o_auto = _stream(dag, 8, kernel_class="auto")
+    assert e_auto.last_kernel_class == "latency"
+    _, o_thr = _stream(dag, 8, kernel_class="throughput")
+    assert o_auto == o_thr
+
+
+def test_auto_dispatch_uses_throughput_for_bulk():
+    """A bulk ingest past LATENCY_K_MAX takes the throughput surface
+    (full-DAG fd strategies, all-rounds fame/order)."""
+    from babble_tpu.consensus.engine import LATENCY_K_MAX
+
+    dag = random_gossip_dag(4, LATENCY_K_MAX + 120, seed=6)
+    eng = TpuHashgraph(dag.participants, verify_signatures=False)
+    for ev in dag.events:
+        eng.insert_event(ev.clone())
+    eng.run_consensus()
+    assert eng.last_kernel_class == "throughput"
+
+
+@pytest.mark.parametrize("seed", [5, 6, 7])
+def test_chaos_fingerprint_parity_incremental_vs_full(seed):
+    """The satellite pin: committed order and chaos fingerprints are
+    identical between the incremental flush path and the full-rescan
+    path across seeds — on the flaky-link-shaped mini scenario (link
+    faults, duplicates, reorders) driven by the deterministic runner."""
+    from babble_tpu.chaos import Scenario, run_scenario
+
+    spec = {
+        "name": "mini-flaky-parity", "nodes": 3, "steps": 48, "seed": seed,
+        "txs": 6, "tx_every": 6, "settle_rounds": 4,
+        "invariants": ["prefix_agreement", "liveness", "all_committed"],
+        "plan": {"default": {"drop": 0.12, "delay": 0.2,
+                             "delay_ms": [1, 3],
+                             "duplicate": 0.1, "reorder": 0.1}},
+    }
+    sc = Scenario.from_dict(spec)
+    a = run_scenario(sc, kernel_class="latency")
+    b = run_scenario(sc, kernel_class="throughput")
+    assert a.report.ok, a.report.format()
+    assert b.report.ok, b.report.format()
+    assert a.committed == b.committed
+    assert a.consensus == b.consensus
+    assert a.fingerprint() == b.fingerprint()
+
+
+def test_chaos_fingerprint_parity_slow_peer_shape(slow_peer_spec=None):
+    """Same parity pin under asymmetric delay (the slow-peer shape that
+    exposed premature intra-round finality): the gate defers decisions
+    identically on both compiled surfaces."""
+    from babble_tpu.chaos import Scenario, run_scenario
+
+    spec = {
+        "name": "mini-slow-parity", "nodes": 4, "steps": 64, "seed": 1,
+        "txs": 6, "tx_every": 8, "settle_rounds": 5,
+        "invariants": ["prefix_agreement", "liveness"],
+        "plan": {
+            "default": {"drop": 0.03},
+            "overrides": [
+                {"src": 2, "delay": 1.0, "delay_ms": [2, 6]},
+                {"dst": 2, "delay": 1.0, "delay_ms": [2, 6]},
+            ],
+        },
+    }
+    sc = Scenario.from_dict(spec)
+    a = run_scenario(sc, kernel_class="latency")
+    b = run_scenario(sc, kernel_class="throughput")
+    assert a.fingerprint() == b.fingerprint()
+
+
+# ----------------------------------------------------------------------
+# ts32: narrowed order-median state
+
+
+@pytest.mark.parametrize("seed,grain", [(1, 1_000), (2, 10_000_000)])
+def test_ts32_median_parity(seed, grain):
+    """i32 relative-timestamp medians (rebase + sort + widen) are
+    bit-identical to the i64 path — including the coarse-granularity
+    DAGs where median ties are common."""
+    dag = random_gossip_dag(4, 180, seed=seed, ts_granularity_ns=grain)
+    e32, o32 = _stream(dag, 8, kernel_class="latency", ts32=True)
+    e64, o64 = _stream(dag, 8, kernel_class="latency", ts32=False)
+    assert o32 == o64
+    assert (np.asarray(e32.state.cts) == np.asarray(e64.state.cts)).all()
+
+
+def test_ts32_span_guard_raises():
+    """Wall-clock-scale spans overflow i32; the engine refuses loudly
+    instead of computing wrong medians."""
+    from babble_tpu.core.event import new_event
+    from babble_tpu.crypto.keys import key_from_scalar
+
+    keys = sorted((key_from_scalar(i + 1) for i in range(2)),
+                  key=lambda k: k.pub_hex)
+    participants = {k.pub_hex: i for i, k in enumerate(keys)}
+    eng = TpuHashgraph(participants, verify_signatures=False, ts32=True)
+    k0, k1 = keys
+    e0 = new_event([], ("", ""), k0.pub_bytes, 0, timestamp=0)
+    e0.sign(k0)
+    eng.insert_event(e0)
+    r1 = new_event([], ("", ""), k1.pub_bytes, 0, timestamp=1)
+    r1.sign(k1)
+    eng.insert_event(r1)
+    e1 = new_event([], (e0.hex(), r1.hex()), k0.pub_bytes, 1,
+                   timestamp=1 << 40)
+    e1.sign(k0)
+    eng.insert_event(e1)
+    with pytest.raises(OverflowError):
+        eng.run_consensus()
+
+
+# ----------------------------------------------------------------------
+# witness-set finality gate (the premature-finality fix, fused twin)
+
+
+def test_finality_gate_defers_until_heads_pass():
+    """With one chain's tail withheld, the gated engine must not decide
+    (and so not commit) rounds the lagging chain's head has not passed;
+    delivering the tail lands the identical committed order the
+    ungated full-knowledge run produced."""
+    dag = random_gossip_dag(4, 160, seed=9)
+    lag = dag.events[-1].creator      # withhold this creator's tail
+    tail = [ev for ev in dag.events if ev.creator == lag][-6:]
+    # the withheld set must be ancestry-closed upward: any event
+    # descending from a held one is held too (topological delivery)
+    held = {ev.hex() for ev in tail}
+    deliver_first, deliver_late = [], []
+    for ev in dag.events:
+        if (ev.hex() in held or ev.self_parent in held
+                or ev.other_parent in held):
+            held.add(ev.hex())
+            deliver_late.append(ev)
+        else:
+            deliver_first.append(ev)
+
+    gated = TpuHashgraph(dag.participants, verify_signatures=False,
+                         finality_gate=True, kernel_class="latency")
+    for ev in deliver_first:
+        gated.insert_event(ev.clone())
+    gated.run_consensus()
+    lcr_held = gated.last_consensus_round
+
+    # the lagging chain's head round must bound every decided round
+    head_chain = [ev for ev in deliver_first if ev.creator == lag]
+    head_round = gated.round(head_chain[-1].hex())
+    assert (lcr_held if lcr_held is not None else -1) <= head_round
+
+    # deliver the tail: decisions resume and match the full-knowledge
+    # run bit for bit
+    for ev in deliver_late:
+        gated.insert_event(ev.clone())
+    gated.run_consensus()
+
+    full, _ = _stream(dag, 8, kernel_class="throughput",
+                      finality_gate=True)
+    assert gated.consensus_events() == full.consensus_events()
+
+
+# ----------------------------------------------------------------------
+# compile-count regression + AOT cache
+
+
+def test_same_shape_flush_stream_zero_recompiles():
+    """The cold-start acceptance pin: once a flush shape has compiled,
+    a stream of same-shape flushes triggers ZERO further XLA compiles
+    and ZERO retraces — counted via the jax.monitoring compilation
+    hook (ops/aot.py), not inferred from wall time."""
+    aot.install_listeners()
+    dag = random_gossip_dag(4, 220, seed=11)
+
+    def stream_once():
+        eng = TpuHashgraph(dag.participants, verify_signatures=False,
+                           kernel_class="latency")
+        flushes = 0
+        for i, ev in enumerate(dag.events):
+            eng.insert_event(ev.clone())
+            if (i + 1) % 4 == 0:
+                eng.run_consensus()
+                flushes += 1
+        return flushes
+
+    # first pass compiles every shape the stream produces...
+    stream_once()
+    c0 = aot.compile_counts()
+    # ...after which an identical flush stream (fresh engine, same
+    # DagConfig, same bucketed shapes) must trigger ZERO XLA compiles
+    # and ZERO retraces — the whole stream rides the compiled programs
+    flushes = stream_once()
+    c1 = aot.compile_counts()
+    assert flushes >= 50
+    assert c1["xla_compiles"] == c0["xla_compiles"], (c0, c1)
+    assert c1["traces"] == c0["traces"], (c0, c1)
+
+
+def test_aot_prewarm_manifest_round_trip(tmp_path):
+    """The AOT cache keyed on DagConfig + engine version: a first run
+    records its compiled shapes in the manifest; prewarm replays them
+    into a fresh engine's executable map, and prewarmed flushes add
+    zero traces (the executable is called directly, no jit dispatch
+    compile)."""
+    cache = str(tmp_path / "aot")
+    dag = random_gossip_dag(4, 80, seed=13)
+
+    eng1 = TpuHashgraph(dag.participants, verify_signatures=False,
+                        kernel_class="latency")
+    eng1._aot_dir = cache             # record shapes without prewarm
+    for i, ev in enumerate(dag.events):
+        eng1.insert_event(ev.clone())
+        if (i + 1) % 4 == 0:
+            eng1.run_consensus()
+    entries = aot.load_manifest(cache)
+    assert entries, "first run must record its compiled shapes"
+    assert all(tuple(e["cfg"]) == tuple(eng1.cfg) for e in entries)
+
+    eng2 = TpuHashgraph(dag.participants, verify_signatures=False,
+                        kernel_class="latency")
+    res = aot.prewarm_engine(eng2, cache)
+    assert res["from_manifest"] == len(entries)
+    assert set(eng2._aot) == {tuple(e["key"]) for e in entries}
+
+    c0 = aot.compile_counts()
+    for i, ev in enumerate(dag.events):
+        eng2.insert_event(ev.clone())
+        if (i + 1) % 4 == 0:
+            eng2.run_consensus()
+    c1 = aot.compile_counts()
+    assert c1["traces"] == c0["traces"], "prewarmed flushes must not trace"
+    assert eng2.consensus_events() == eng1.consensus_events()
+
+
+def test_manifest_version_mismatch_ignored(tmp_path):
+    """A manifest from another engine version must not prewarm."""
+    import json
+
+    cache = tmp_path / "aot"
+    cache.mkdir()
+    (cache / "babble_aot_manifest.json").write_text(json.dumps(
+        {"version": "0.0-stale", "entries": [{"cfg": [], "key": []}]}
+    ))
+    assert aot.load_manifest(str(cache)) == []

@@ -459,3 +459,66 @@ def test_bombard_smoke_shed_and_prefix_agreement(tmp_path):
 
         time.sleep(2.0)
         asyncio.run(polite())
+
+
+def test_push_streams_continuation_frames_for_deep_catchup(monkeypatch):
+    """ISSUE 7 satellite: a push diff larger than the per-frame event
+    cap streams continuation frames over the multiplexed connection —
+    each keyed on the peer's post-insert Known from the previous ack —
+    instead of shipping one frame and leaving the tail to pull rounds."""
+    from babble_tpu.node import node as node_mod
+
+    monkeypatch.setattr(node_mod, "PUSH_MAX_EVENTS", 8)
+
+    async def go():
+        # consensus stays off the push window (the first pipeline
+        # compile would hold the receiver's core lock past the test
+        # transport timeout)
+        nodes, proxies, addrs = _mk_nodes(2, pipeline=True,
+                                          consensus_interval=1e9)
+        a, b = nodes
+        for n in nodes:
+            n.run_task(gossip=False)
+        assert await a._gossip(addrs[1]) is True     # seed the Known cache
+        pulls_seeded = a._m_sync_requests.value
+        # deep backlog: far more events than one (patched) frame holds
+        for i in range(40):
+            assert a.core.add_self_event([b"deep%d" % i])
+        assert await a._gossip_step(addrs[1]) is True
+        # the peer caught ALL the way up in one gossip step...
+        assert b.core.hg.known()[a.core.id] == a.core.hg.known()[a.core.id]
+        # ...via continuation frames, not pull rounds
+        assert a._m_push_frames.value >= 4, a._m_push_frames.value
+        assert a._m_push_total.value >= 5
+        assert a._m_sync_requests.value == pulls_seeded
+        for n in nodes:
+            await n.shutdown()
+
+    asyncio.run(go())
+
+
+def test_push_stream_cap_bounds_one_gossip(monkeypatch):
+    """push_stream_max bounds the frames one gossip may chain; the
+    remaining tail rides later gossips (or reconciliation)."""
+    from babble_tpu.node import node as node_mod
+
+    monkeypatch.setattr(node_mod, "PUSH_MAX_EVENTS", 4)
+
+    async def go():
+        nodes, proxies, addrs = _mk_nodes(2, pipeline=True,
+                                          consensus_interval=1e9,
+                                          push_stream_max=2)
+        a, b = nodes
+        for n in nodes:
+            n.run_task(gossip=False)
+        assert await a._gossip(addrs[1]) is True
+        for i in range(40):
+            assert a.core.add_self_event([b"capped%d" % i])
+        assert await a._gossip_step(addrs[1]) is True
+        # exactly the cap's worth of continuations flew
+        assert a._m_push_frames.value == 2
+        assert b.core.hg.known()[a.core.id] < a.core.hg.known()[a.core.id]
+        for n in nodes:
+            await n.shutdown()
+
+    asyncio.run(go())

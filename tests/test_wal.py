@@ -473,3 +473,160 @@ def test_segment_rotation_recovers_across_files(tmp_path):
     wal2 = WriteAheadLog(str(tmp_path / "w"), fsync="off")
     assert [e.hex() for e in wal2.recovered_events] == \
         [e.hex() for e in evs]
+
+
+# ----------------------------------------------------------------------
+# per-record commit markers (fsync=always probe skip — ISSUE 7 satellite)
+
+
+def _always_log(tmp_path, n=5):
+    keys, _ = _participants(1)
+    d = str(tmp_path / "wal-always")
+    w = WriteAheadLog(d, fsync="always")
+    for ev in _chain(keys[0], n):
+        w.append(ev)
+    w.abort()            # crash-style close: no receipt, no clean marker
+    return d
+
+
+def test_always_torn_tail_skips_probe(tmp_path):
+    """fsync=always appends fsync BEFORE the event can gossip, and each
+    fsynced record gets a commit-marker frame behind it.  A torn
+    in-flight record at the tail therefore proves nothing published was
+    lost — recovery truncates it and skips the peer seq probe."""
+    d = _always_log(tmp_path)
+    with open(_segment(d), "ab") as f:
+        f.write(b"\x55\x00\x00")          # torn header of the in-flight record
+    w = WriteAheadLog(d, fsync="always")
+    assert len(w.recovered_events) == 5
+    assert w.truncated_records == 1
+    assert w.marker_disciplined
+    assert not w.needs_probe
+
+
+def test_always_unclean_shutdown_skips_probe(tmp_path):
+    """An unclean shutdown with an intact marker-disciplined log: the
+    markers are in-file proof the previous incarnation ran always, so
+    no record suffix can have been lost at an fsync boundary."""
+    d = _always_log(tmp_path, n=3)
+    w = WriteAheadLog(d, fsync="always")
+    assert w.marker_disciplined
+    assert not w.needs_probe
+    # the discipline evidence outranks the CURRENT policy config too
+    w2 = WriteAheadLog(d, fsync="batch")
+    assert not w2.needs_probe
+
+
+def test_always_mid_log_rot_still_probes(tmp_path):
+    """Bit rot on a marker-confirmed (acked, possibly published) record
+    is durable-history loss, not an in-flight tear: the probe must arm."""
+    d = _always_log(tmp_path)
+    seg = _segment(d)
+    data = bytearray(open(seg, "rb").read())
+    data[20] ^= 0xFF                      # flip a byte inside record 0/1
+    open(seg, "wb").write(bytes(data))
+    w = WriteAheadLog(d, fsync="always")
+    assert w.truncated_records >= 1
+    assert w.needs_probe
+
+
+def test_batch_torn_tail_still_probes(tmp_path):
+    """No markers (batch/off policy) -> a torn tail keeps the PR-5
+    behavior: recovery cannot vouch for published seqs, so it probes."""
+    keys, _ = _participants(1)
+    d = str(tmp_path / "wal-batch")
+    w = WriteAheadLog(d, fsync="off")
+    for ev in _chain(keys[0], 5):
+        w.append(ev)
+    w.abort()
+    with open(_segment(d), "ab") as f:
+        f.write(b"\x55\x00\x00")
+    w2 = WriteAheadLog(d, fsync="off")
+    assert not w2.marker_disciplined
+    assert w2.needs_probe
+
+
+def test_corrupt_final_record_with_marker_probes(tmp_path):
+    """A whole-but-corrupt FINAL frame followed by its commit marker:
+    the marker proves the record was acked before the crash — that is
+    rot on durable (possibly published) history, so the probe arms."""
+    d = _always_log(tmp_path)
+    seg = _segment(d)
+    data = bytearray(open(seg, "rb").read())
+    # the layout ends ...[record N][marker N]; corrupt record N's payload
+    # (marker frames are 8 bytes, so the last record's payload ends 9+
+    # bytes before EOF)
+    data[-12] ^= 0xFF
+    open(seg, "wb").write(bytes(data))
+    w = WriteAheadLog(d, fsync="always")
+    assert w.truncated_records == 1
+    assert w.needs_probe
+
+
+def test_marker_only_tear_counts_no_lost_records(tmp_path):
+    """A torn/corrupt trailing commit MARKER whose record was recovered
+    intact lost no event data: the truncation counter must stay 0 (the
+    PR-5 'report actual damage' contract) and no probe arms."""
+    d = _always_log(tmp_path, n=4)
+    seg = _segment(d)
+    data = bytearray(open(seg, "rb").read())
+    data[-1] ^= 0xFF           # corrupt the final marker's crc byte
+    open(seg, "wb").write(bytes(data))
+    w = WriteAheadLog(d, fsync="always")
+    assert len(w.recovered_events) == 4
+    assert w.truncated_records == 0
+    assert not w.needs_probe
+
+
+def test_policy_downgrade_lost_suffix_still_probes(tmp_path):
+    """Markers prove a PREFIX ran fsync=always, not the previous
+    incarnation: after a downgrade to batch/off, a crash can lose the
+    whole buffered suffix with no trace — the durable policy stamp
+    (written at each open) is what recovery trusts, so the stale
+    marker discipline must NOT skip the probe."""
+    keys, _ = _participants(1)
+    d = _always_log(tmp_path, n=3)
+    # a batch-mode incarnation opens (re-stamps the policy), appends a
+    # suffix that never reaches disk, and crashes: simulate by opening
+    # and aborting — the on-disk log is bit-identical to the pure
+    # always-era one except for the stamp
+    w = WriteAheadLog(d, fsync="off")
+    assert not w.needs_probe        # stamp still said "always" here
+    w.abort()
+    w2 = WriteAheadLog(d, fsync="off")
+    assert w2.marker_disciplined    # stale prefix evidence...
+    assert w2.needs_probe           # ...must not skip the probe
+
+
+def test_torn_short_marker_counts_no_lost_records(tmp_path):
+    """A marker torn to fewer than 8 bytes: the final recovered record
+    is UNMARKED (its marker is the torn frame), so no event data was
+    lost — distinguished from a torn in-flight RECORD, whose
+    predecessor's marker is intact and which stays counted."""
+    d = _always_log(tmp_path, n=4)
+    seg = _segment(d)
+    data = open(seg, "rb").read()
+    open(seg, "wb").write(data[:-3])      # chop the final marker short
+    w = WriteAheadLog(d, fsync="always")
+    assert len(w.recovered_events) == 4
+    assert w.truncated_records == 0
+    assert not w.needs_probe
+
+
+def test_always_reopen_over_unmarked_records_still_probes(tmp_path):
+    """The policy stamp alone must not vouch for records that do not
+    show the marker discipline: a batch-era log reopened (and crashed)
+    by an always incarnation keeps probing — those unmarked records'
+    era could have lost a buffered suffix at a clean EOF."""
+    keys, _ = _participants(1)
+    d = str(tmp_path / "wal-mixed")
+    w = WriteAheadLog(d, fsync="off")          # batch-era records, no markers
+    for ev in _chain(keys[0], 3):
+        w.append(ev)
+    w.abort()
+    w2 = WriteAheadLog(d, fsync="always")      # stamps "always", appends nothing
+    w2.abort()
+    w3 = WriteAheadLog(d, fsync="always")
+    assert w3._prev_always
+    assert not w3.marker_disciplined
+    assert w3.needs_probe
