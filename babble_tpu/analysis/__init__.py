@@ -14,6 +14,9 @@ Rule families (see ISSUE 1/4 / the rules' module docstrings):
   (``held-guard-escape``)
 - :mod:`.walgossip` — self-event mint paths must pass through
   ``wal.append`` before gossiping (``wal-before-gossip``)
+- :mod:`.snapshotadopt` — engines built from peer-supplied snapshot
+  bytes must reach the signed-state-proof verification helpers
+  (``unverified-snapshot-adopt``)
 
 The flow-aware rules stand on :mod:`.graph` (module symbol table +
 project call graph), built once per run by the engine and attached to
@@ -57,6 +60,7 @@ from .tracer import (
     JitTracedBranchRule,
     JitUnhashableStaticRule,
 )
+from .snapshotadopt import UnverifiedSnapshotAdoptRule
 from .walgossip import WalBeforeGossipRule
 
 ALL_RULES = [
@@ -72,6 +76,7 @@ ALL_RULES = [
     DrainBeforeValidateRule(),
     FalsyOrFallbackRule(),
     WalBeforeGossipRule(),
+    UnverifiedSnapshotAdoptRule(),
 ]
 
 RULE_NAMES = ({r.name for r in ALL_RULES}
@@ -102,5 +107,6 @@ __all__ = [
     "JitHostSyncRule",
     "JitTracedBranchRule",
     "JitUnhashableStaticRule",
+    "UnverifiedSnapshotAdoptRule",
     "WalBeforeGossipRule",
 ]

@@ -26,6 +26,7 @@ from .plan import FaultPlan
 #: injector log / fault_counts and pre-exist as metric series)
 FAULT_KINDS = (
     "drop", "delay", "duplicate", "reorder", "partition", "stale_replay",
+    "forged_snapshot",
     "checkpoint_corrupt", "checkpoint_truncate", "wal_corrupt",
     "wal_truncate",
 )
@@ -170,6 +171,21 @@ class FaultInjector:
 
     def stale_pick(self, node: int, n_cached: int) -> int:
         return self.node_rng(node).randrange(n_cached)
+
+    def is_snapshot_forger(self, node: int) -> bool:
+        b = self.plan.byzantine
+        return (b is not None and b.mode == "forge_snapshot"
+                and b.node == node)
+
+    def snapshot_forge(self, node: int) -> bool:
+        """Should this outgoing fast-forward response be doctored?
+        Deterministic (every response once the activation tick passed —
+        forging draws no randomness, so adding the actor never shifts
+        any other fault stream); suppressed during quiesce like every
+        other fault so the settle phase can converge."""
+        if self.quiesce or not self.is_snapshot_forger(node):
+            return False
+        return self.tick >= self.plan.byzantine.at
 
     # ------------------------------------------------------------------
 

@@ -22,6 +22,16 @@ run actually observed:
   engine the attack is invisible.
 - **fast_forwarded**: a crashed-and-restarted node caught back up via
   the snapshot RPC (at least one fast-forward completed).
+- **eviction_advanced** (ISSUE 8): while a creator was down, the
+  surviving fleet's eviction horizon moved PAST it (its retained tail
+  evicted, a per-creator horizon recorded) and the live slot window
+  stayed bounded — the silent peer no longer pins memory for the
+  length of its outage.
+- **ff_proof_rejected** (ISSUE 8): the forge_snapshot byzantine
+  actor's doctored snapshot was refused by at least one joiner
+  (babble_ff_proof_rejects_total >= 1) — paired with prefix_agreement
+  and fast_forwarded, this is "reject the forgery loudly AND still
+  recover through an honest peer".
 
 The checker never raises mid-collection: it gathers every violation and
 reports them all, because a scenario that breaks two invariants at once
@@ -231,6 +241,51 @@ class InvariantChecker:
                        "fork-aware mode is off, so the fork is invisible)"
                        if scenario.engine != "byzantine" else ""),
                 ))
+
+    def _check_eviction_advanced(self, scenario, result, report) -> None:
+        crashed = [c.node for c in scenario.plan.crashes]
+        if not crashed:
+            report.violations.append(Violation(
+                "eviction_advanced",
+                "scenario declares the eviction_advanced invariant but "
+                "no node ever crashes",
+            ))
+            return
+        for node in crashed:
+            if result.eviction_horizons.get(node, -1) < 0:
+                report.violations.append(Violation(
+                    "eviction_advanced",
+                    f"no surviving node ever evicted silent creator "
+                    f"{node}'s retained tail — the eviction horizon "
+                    "never moved past the dead peer",
+                ))
+        bound = 8 * scenario.cache_size
+        if result.outage_live_window_max > bound:
+            report.violations.append(Violation(
+                "eviction_advanced",
+                f"live slot window reached "
+                f"{result.outage_live_window_max} during the outage "
+                f"(bound {bound} = 8x cache_size) — memory grew with "
+                "the outage instead of staying bounded",
+            ))
+
+    def _check_ff_proof_rejected(self, scenario, result, report) -> None:
+        byz = scenario.plan.byzantine
+        if byz is None or byz.mode != "forge_snapshot":
+            report.violations.append(Violation(
+                "ff_proof_rejected",
+                "scenario declares the ff_proof_rejected invariant but "
+                "no forge_snapshot byzantine actor",
+            ))
+            return
+        if not any(v > 0 for v in result.ff_proof_rejects.values()):
+            report.violations.append(Violation(
+                "ff_proof_rejected",
+                "no node ever rejected the forged snapshot "
+                "(babble_ff_proof_rejects_total stayed 0) — either the "
+                "forgery was silently installed or the joiner never "
+                "met the forger",
+            ))
 
     def _check_fast_forwarded(self, scenario, result, report) -> None:
         restarted = sorted(result.restarted)

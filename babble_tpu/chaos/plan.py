@@ -26,9 +26,11 @@ KNOWN_INVARIANTS = (
     "all_committed",      # every submitted tx reaches the honest logs
     "fork_detected",      # every honest node flagged the equivocation
     "fast_forwarded",     # a restarted node caught up via snapshot
+    "eviction_advanced",  # a silent creator's tail evicted; memory bounded
+    "ff_proof_rejected",  # a forged snapshot was refused (proof quorum)
 )
 
-BYZANTINE_MODES = ("fork", "stale_replay")
+BYZANTINE_MODES = ("fork", "stale_replay", "forge_snapshot")
 
 
 def _prob(v, name: str) -> float:
@@ -191,7 +193,12 @@ class ByzantineSpec:
     """One byzantine actor.  ``fork`` mints an equivocating event at
     tick ``at`` and plants the branches at two different peers;
     ``stale_replay`` answers inbound syncs with a cached stale response
-    with probability ``prob`` from tick ``at`` on."""
+    with probability ``prob`` from tick ``at`` on; ``forge_snapshot``
+    answers every fast-forward request from tick ``at`` on with a
+    DOCTORED snapshot — committed history rewritten, digest recomputed
+    self-consistently, proof re-signed under the actor's own key — the
+    protocol-aware-recovery attack verified fast-forward exists to
+    refuse."""
 
     node: int
     mode: str = "fork"
@@ -319,6 +326,10 @@ class Scenario:
     engine: str = "fused"
     cache_size: int = 512
     seq_window: Optional[int] = None
+    #: per-creator eviction: decided rounds of silence after which a
+    #: creator's retained tail evicts (None = node-config default; the
+    #: dead-creator scenario sets it low so the outage crosses it)
+    inactive_rounds: Optional[int] = None
     txs: int = 16
     tx_every: int = 5
     invariants: Tuple[str, ...] = ("prefix_agreement", "liveness")
@@ -357,6 +368,7 @@ class Scenario:
             "name": self.name, "nodes": self.nodes, "steps": self.steps,
             "seed": self.seed, "engine": self.engine,
             "cache_size": self.cache_size, "seq_window": self.seq_window,
+            "inactive_rounds": self.inactive_rounds,
             "txs": self.txs, "tx_every": self.tx_every,
             "invariants": list(self.invariants),
             "liveness_bound": self.liveness_bound,
@@ -372,9 +384,9 @@ class Scenario:
         plan = FaultPlan.from_dict(d.pop("plan", {}))
         known = {
             "name", "nodes", "steps", "seed", "engine", "cache_size",
-            "seq_window", "txs", "tx_every", "invariants",
-            "liveness_bound", "settle_rounds", "checkpoint_every",
-            "tick_seconds",
+            "seq_window", "inactive_rounds", "txs", "tx_every",
+            "invariants", "liveness_bound", "settle_rounds",
+            "checkpoint_every", "tick_seconds",
         }
         extra = set(d) - known
         if extra:

@@ -83,6 +83,16 @@ class ScenarioResult:
     fork_detected: Dict[int, bool] = field(default_factory=dict)
     fast_forwards: Dict[int, int] = field(default_factory=dict)
     fork_attack: Optional[dict] = None
+    #: per-creator eviction observations (ISSUE 8): for every crashed
+    #: creator, the highest eviction-horizon index a surviving node
+    #: recorded for it during the outage (-1 = its tail never evicted)
+    eviction_horizons: Dict[int, int] = field(default_factory=dict)
+    #: max live-window slot count observed on survivors while any node
+    #: was down — the memory-bounded half of eviction_advanced
+    outage_live_window_max: int = 0
+    #: fast-forward snapshots each node refused on proof failure
+    #: (babble_ff_proof_rejects_total at run end)
+    ff_proof_rejects: Dict[int, int] = field(default_factory=dict)
     report: Optional[InvariantReport] = None
 
     def fingerprint(self) -> str:
@@ -119,6 +129,13 @@ class ScenarioResult:
                 str(k): v for k, v in sorted(self.fast_forwards.items())
             },
             "fork_attack": self.fork_attack,
+            "eviction_horizons": {
+                str(k): v for k, v in sorted(self.eviction_horizons.items())
+            },
+            "outage_live_window_max": self.outage_live_window_max,
+            "ff_proof_rejects": {
+                str(k): v for k, v in sorted(self.ff_proof_rejects.items())
+            },
             "invariants": self.report.to_dict() if self.report else None,
         }
 
@@ -207,6 +224,8 @@ class ScenarioRunner:
             conf = Config.test_config(heartbeat=1.0)
             conf.cache_size = sc.cache_size
             conf.seq_window = sc.seq_window
+            if sc.inactive_rounds is not None:
+                conf.inactive_rounds = sc.inactive_rounds
             conf.kernel_class = self.kernel_class
             conf.byzantine = (sc.engine == "byzantine")
             # positive interval with gossip=False means: syncs only mark
@@ -221,7 +240,11 @@ class ScenarioRunner:
 
         def boot(h: _Handle, engine=None) -> None:
             inner = net.transport(h.addr)
-            transport = FaultyTransport(inner, injector, h.idx, addr_index)
+            transport = FaultyTransport(
+                inner, injector, h.idx, addr_index,
+                forge_key=(h.key if injector.is_snapshot_forger(h.idx)
+                           else None),
+            )
             h.proxy = InmemAppProxy()
             h.node = Node(make_conf(h.idx), h.key, peers, transport,
                           h.proxy, engine=engine)
@@ -249,6 +272,11 @@ class ScenarioRunner:
         result.heal_tick = heal_tick
         submitted = 0
         fork_done = False
+        #: deterministic forger encounters: a node restarting under a
+        #: forge_snapshot actor gossips AT the forger first, so the
+        #: forged-fast-forward path is exercised on every seed instead
+        #: of depending on the random peer draw finding the actor
+        forced_gossip: List[tuple] = []
 
         async def gossip_once(a: int, b: int) -> None:
             await handles[a].node._gossip(addrs[b])
@@ -307,6 +335,10 @@ class ScenarioRunner:
                         h.restarted = True
                         result.restarted.add(node_idx)
                         injector.record("restart", node_idx, node_idx)
+                        if (byz is not None
+                                and byz.mode == "forge_snapshot"
+                                and byz.node != node_idx):
+                            forced_gossip.append((node_idx, byz.node))
                 if (durable and sc.checkpoint_every > 0
                         and step % sc.checkpoint_every
                         == sc.checkpoint_every - 1):
@@ -347,7 +379,11 @@ class ScenarioRunner:
                         fork_done = True
 
                 live_idx = [h.idx for h in handles if h.alive]
-                if len(live_idx) >= 2:
+                if (forced_gossip and handles[forced_gossip[0][0]].alive
+                        and handles[forced_gossip[0][1]].alive):
+                    a, b = forced_gossip.pop(0)
+                    await gossip_once(a, b)
+                elif len(live_idx) >= 2:
                     a = rng.choice(live_idx)
                     # deliberate: the target draw includes crashed nodes
                     # — a real peer selector dials from peers.json with
@@ -355,6 +391,31 @@ class ScenarioRunner:
                     # dial-a-dead-peer failure exactly like production
                     b = rng.choice([i for i in range(n) if i != a])
                     await gossip_once(a, b)
+
+                # silent-peer observations (eviction_advanced): while
+                # any node is down, sample the survivors' live-window
+                # size and any eviction horizon recorded for the dead
+                # creators — host mirrors only, no device sync
+                down = [h.idx for h in handles if not h.alive]
+                if down:
+                    for h in handles:
+                        if not h.alive:
+                            continue
+                        snap = h.node.core.hg.stats_snapshot()
+                        result.outage_live_window_max = max(
+                            result.outage_live_window_max,
+                            int(snap.get("live_window", 0)),
+                        )
+                        heads = getattr(
+                            h.node.core.hg.dag, "evicted_heads", {}
+                        )
+                        for d in down:
+                            horizon = heads.get(d)
+                            if horizon is not None:
+                                result.eviction_horizons[d] = max(
+                                    result.eviction_horizons.get(d, -1),
+                                    horizon[0],
+                                )
 
                 if step % self.consensus_every == self.consensus_every - 1:
                     await self._consensus_pass(handles)
@@ -392,6 +453,9 @@ class ScenarioRunner:
                 snap = h.node.core.hg.stats_snapshot()
                 result.fork_detected[h.idx] = (
                     snap.get("forked_creators", 0) > 0
+                )
+                result.ff_proof_rejects[h.idx] = int(
+                    h.node._m_ff_rejects.value
                 )
                 # a completed fast-forward swapped the engine object the
                 # node restarted with — attempt counters alone can't

@@ -119,6 +119,48 @@ CANNED: Dict[str, dict] = {
             ],
         },
     },
+    # silent-peer survival (ISSUE 8): a peer goes down mid-life — after
+    # its events propagated — and stays silent for hundreds of ticks.
+    # Pre-PR this wedged eviction fleet-wide: the dead creator's
+    # seq-window tail could never evict, the slot prefix could never
+    # advance past it, and memory grew for the whole outage (ROADMAP
+    # eviction-wedge open item).  With per-creator eviction the fleet
+    # must (a) evict the silent creator's tail once it falls
+    # inactive_rounds decided rounds behind (eviction_advanced: horizon
+    # recorded AND live window bounded), and (b) bootstrap its return
+    # through verified fast-forward + post-horizon chain continuation
+    # (fast_forwarded + prefix agreement across the rejoin)
+    "dead-creator": {
+        "name": "dead-creator",
+        "nodes": 4, "steps": 560, "seed": 31,
+        "cache_size": 64, "seq_window": 8, "inactive_rounds": 8,
+        "txs": 12, "tx_every": 10, "liveness_bound": 110,
+        "invariants": ["prefix_agreement", "liveness", "fast_forwarded",
+                       "eviction_advanced"],
+        "plan": {
+            "crashes": [{"node": 3, "crash": 60, "restart": 430}],
+        },
+    },
+    # byzantine bootstrap peer (ISSUE 8 / FAST'18 protocol-aware
+    # recovery): node 1 answers fast-forward requests with a DOCTORED
+    # snapshot — committed history rewritten, digest recomputed
+    # self-consistently, proof re-signed under its own key.  The
+    # restarted joiner is steered at the forger first (deterministic
+    # encounter), must refuse the forgery on the attestation quorum
+    # (ff_proof_rejected) and still catch up through an honest peer
+    # (fast_forwarded + prefix agreement)
+    "forged-snapshot": {
+        "name": "forged-snapshot",
+        "nodes": 4, "steps": 520, "seed": 37,
+        "cache_size": 64, "seq_window": 8, "inactive_rounds": 8,
+        "txs": 12, "tx_every": 10, "liveness_bound": 110,
+        "invariants": ["prefix_agreement", "liveness", "fast_forwarded",
+                       "ff_proof_rejected"],
+        "plan": {
+            "crashes": [{"node": 3, "crash": 50, "restart": 400}],
+            "byzantine": {"node": 1, "mode": "forge_snapshot", "at": 0},
+        },
+    },
     # a stale-sync replayer answers a sampled fraction of inbound syncs
     # with cached old state; dedup-by-hash must shrug it off
     "stale-replay": {

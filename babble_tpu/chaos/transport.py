@@ -28,7 +28,12 @@ import asyncio
 from collections import deque
 from typing import Dict, Optional
 
-from ..net.commands import PushRequest, SyncRequest, SyncResponse
+from ..net.commands import (
+    FastForwardResponse,
+    PushRequest,
+    SyncRequest,
+    SyncResponse,
+)
 from ..net.transport import RPC, Transport, TransportError
 from ..obs import Registry
 from .injector import FAULT_KINDS, FaultInjector
@@ -42,11 +47,17 @@ class FaultyTransport(Transport):
         node_id: int,
         addr_index: Dict[str, int],
         registry: Optional[Registry] = None,
+        forge_key=None,
     ):
         self.inner = inner
         self.injector = injector
         self.node_id = node_id
         self.addr_index = dict(addr_index)
+        #: participant key of the forge_snapshot byzantine actor — the
+        #: doctored snapshot must carry a self-consistent re-signed
+        #: proof, or the forgery dies at the joiner's cheapest check
+        #: instead of exercising the attestation quorum (chaos/forge.py)
+        self._forge_key = forge_key
         self._closed = False
         self._consumer: "asyncio.Queue[RPC]" = asyncio.Queue()
         self._pump: Optional[asyncio.Task] = None
@@ -100,7 +111,7 @@ class FaultyTransport(Transport):
     def _needs_pump(self) -> bool:
         return bool(self.injector.plan.partitions) or (
             self.injector.is_stale_replayer(self.node_id)
-        )
+        ) or self.injector.is_snapshot_forger(self.node_id)
 
     async def sync(self, target, req, timeout=None):
         if self._closed:
@@ -213,11 +224,11 @@ class FaultyTransport(Transport):
                 continue
             fwd = RPC(command=req)
             self._consumer.put_nowait(fwd)
-            t = asyncio.ensure_future(self._snoop(rpc, fwd))
+            t = asyncio.ensure_future(self._snoop(rpc, fwd, src))
             self._bg.add(t)
             t.add_done_callback(self._bg.discard)
 
-    async def _snoop(self, orig: RPC, fwd: RPC) -> None:
+    async def _snoop(self, orig: RPC, fwd: RPC, src=None) -> None:
         """Relay the node's answer back to the caller's RPC, caching
         sync responses for the stale-replay actor.  Error strings pass
         through verbatim — the ``too_late:`` marker the fast-forward
@@ -231,4 +242,22 @@ class FaultyTransport(Transport):
             return
         if isinstance(resp, SyncResponse):
             self._stale_cache.append(resp)
+        if (isinstance(resp, FastForwardResponse)
+                and self._forge_key is not None
+                and self.injector.snapshot_forge(self.node_id)):
+            from .forge import forge_snapshot_response
+
+            # executor hop: the forgery re-packs a multi-MB snapshot
+            # (codec-on-loop discipline); awaited before respond, so
+            # the runner's sequential determinism is untouched
+            forged = await asyncio.get_running_loop().run_in_executor(
+                None, forge_snapshot_response, resp, self._forge_key
+            )
+            if forged is not resp:
+                self.injector.record(
+                    "forged_snapshot", self.node_id,
+                    src if src is not None else -1,
+                )
+                self._count("forged_snapshot")
+                resp = forged
         orig.respond(resp)

@@ -164,6 +164,14 @@ async def _run_node(args) -> int:
         coalesce_latency=getattr(args, "coalesce_latency", 50) / 1000.0,
         mint_backpressure=getattr(args, "mint_backpressure", 0) or None,
         seq_window=args.seq_window or None,
+        # 0 disables the inactivity policy (a silent peer then pins
+        # eviction fleet-wide, the pre-PR-8 behavior); -1 = default
+        inactive_rounds=(
+            None if getattr(args, "inactive_rounds", -1) == 0
+            else (getattr(args, "inactive_rounds", -1)
+                  if getattr(args, "inactive_rounds", -1) > 0 else 32)
+        ),
+        ff_verify=not getattr(args, "no_ff_verify", False),
         byzantine=args.byzantine,
         fork_k=args.fork_k,
         fork_caps=_parse_fork_caps(getattr(args, "fork_caps", "")),
@@ -277,7 +285,13 @@ def _chaos_wrap(transport, args, key, peers):
         plan, seed,
         clock=lambda: (time.time() - epoch) / tick_seconds,
     )
-    return FaultyTransport(transport, injector, own, addr_index)
+    return FaultyTransport(
+        transport, injector, own, addr_index,
+        # the forge_snapshot actor needs its own participant key to
+        # re-sign the doctored proof — without it the mode would be a
+        # silent no-op in live fleets
+        forge_key=(key if injector.is_snapshot_forger(own) else None),
+    )
 
 
 async def _checkpoint_loop(node, ckpt_dir: str, interval: float) -> None:
@@ -679,6 +693,15 @@ def main(argv=None) -> int:
                          "of growing)")
     rn.add_argument("--seq_window", type=int, default=0,
                     help="per-creator rolling window (0 = cache_size)")
+    rn.add_argument("--inactive_rounds", type=int, default=-1,
+                    help="per-creator eviction: decided rounds of "
+                         "silence before a creator's retained tail "
+                         "evicts (its return then fast-forwards); "
+                         "-1 = default 32, 0 = disabled")
+    rn.add_argument("--no_ff_verify", action="store_true",
+                    help="skip signed-state-proof verification on "
+                         "fast-forward snapshots (trust any serving "
+                         "peer — the pre-PR-8 model)")
     rn.add_argument("--kernel_class", default="auto",
                     choices=("auto", "latency", "throughput"),
                     help="compiled-surface pin for the fused engine: "

@@ -31,6 +31,7 @@ import numpy as np
 from ..common import OffsetList
 from ..core.dag import HostDag, InsertError
 from ..core.event import Event, WireEvent
+from .digest import CommitDigest
 from ..ops import fame as fame_ops
 from ..ops import flush as flush_ops
 from ..ops import ingest as ingest_ops
@@ -38,6 +39,8 @@ from ..ops import order as order_ops
 from ..ops.state import (
     FAME_TRUE,
     FAME_UNDEFINED,
+    HEAD_GATE_HORIZON,
+    INT32_MAX,
     DagConfig,
     DagState,
     bucket,
@@ -70,6 +73,9 @@ class TpuHashgraph:
     finality_gate = False
     kernel_class = "throughput"
     last_kernel_class: Optional[str] = None
+    flush_fallbacks = 0
+    inactive_rounds: Optional[int] = None
+    _evicted_creators_cache = 0
 
     def __init__(
         self,
@@ -87,6 +93,7 @@ class TpuHashgraph:
         finality_gate: bool = False,
         ts32: bool = False,
         kernel_class: str = "auto",
+        inactive_rounds: Optional[int] = 32,
     ):
         n = len(participants)
         self.participants = participants
@@ -139,8 +146,29 @@ class TpuHashgraph:
             e_cap // 4, 32
         )
         self.consensus_window = consensus_window
+        # Per-creator eviction (ISSUE 8): a creator whose chain head is
+        # more than inactive_rounds DECIDED rounds behind lcr loses its
+        # seq_window retention — its tail becomes evictable, the slot
+        # prefix can advance past it, and its (index, hex) eviction
+        # horizon (dag.evicted_heads) is what its eventual return
+        # bootstraps against.  None disables (pre-PR behavior: one dead
+        # peer pins eviction fleet-wide for the whole outage).
+        self.inactive_rounds = inactive_rounds
+        #: creators whose whole retained window has been evicted (the
+        #: babble_evicted_creators gauge; maintained by maybe_compact)
+        self._evicted_creators_cache = 0
+        #: flushes where the latency window could not cover the
+        #: undecided round span (babble_flush_fallbacks_total): either
+        #: deferred in place because a stalled finality gate makes the
+        #: uncovered rounds undecidable anyway, or degraded to the
+        #: throughput surface for run-to-completion
+        self.flush_fallbacks = 0
+        self._fallback_counted = False   # per-flush dedup for the gauge
 
         self.consensus = OffsetList()             # hex ids in consensus order
+        #: rolling hash chain over the committed order — the attestable
+        #: frontier signed fast-forward proofs are built on (digest.py)
+        self._digest = CommitDigest()
         self.consensus_transactions = 0
         self.last_committed_round_events = 0
         self._received: set = set()               # global slots already ordered
@@ -184,7 +212,28 @@ class TpuHashgraph:
             # rolling-window gauges: total history vs what's actually held
             "evicted_events": self.dag.slot_base,
             "live_window": self.dag.n_events - self.dag.slot_base,
+            # creators whose retained tail was evicted for inactivity
+            # (their return must bootstrap through verified fast-forward)
+            "evicted_creators": self._evicted_creators_cache,
         }
+
+    # ------------------------------------------------------------------
+    # commit digest (verified fast-forward, store/proof.py)
+
+    @property
+    def commit_digest(self) -> str:
+        """Digest over the full committed order so far (O(1) state)."""
+        return self._digest.head
+
+    @property
+    def commit_length(self) -> int:
+        return self._digest.length
+
+    def commit_digest_at(self, position: int) -> Optional[str]:
+        """Digest after the first ``position`` commits — the attestation
+        peers answer during a joiner's fast-forward proof check; None
+        when the position is ahead of us or rolled off history."""
+        return self._digest.digest_at(position)
 
     # ------------------------------------------------------------------
     # ingestion
@@ -401,6 +450,7 @@ class TpuHashgraph:
         new_events = consensus_sort(new_events, self._round_prn)
         for ev in new_events:
             self.consensus.append(ev.hex())
+            self._digest.note(ev.hex())
             self.consensus_transactions += len(ev.transactions)
 
         lcr = int(self.state.lcr)
@@ -483,6 +533,25 @@ class TpuHashgraph:
             self._max_round_cache - max(self._lcr_cache, -1)
             + max(2, levels_new // 4 + 1)
         )
+        if self.finality_gate and est > HEAD_GATE_HORIZON + 2:
+            # Stall fallback (PR 7 leftover d): a stalled finality gate
+            # (all peers down K rounds: the lone live chain piles up
+            # LEVELS without advancing rounds, and deep undecided spans
+            # survive the staleness horizon) inflated the raw span
+            # estimate past every W bucket, silently forcing each flush
+            # onto the expensive throughput surface for the whole
+            # outage.  Rounds beyond head_round_min + 1 cannot decide
+            # while the gate stalls, so a window of the staleness
+            # horizon is all fame/order can use — cap the estimate
+            # there (counted on babble_flush_fallbacks_total) and let
+            # _flush_live's undershoot check (which consults the host
+            # head-round minimum) degrade only when the gap is NOT
+            # gate-explained.
+            self.flush_fallbacks += 1
+            self._fallback_counted = True
+            est = HEAD_GATE_HORIZON + 2
+        else:
+            self._fallback_counted = False
         w = flush_ops.bucket_w(max(est, 1), self.cfg.r_cap)
         if w == 0:
             return False
@@ -530,6 +599,19 @@ class TpuHashgraph:
             self.decide_fame()
             return self.find_order()
         if self._max_round_cache > max(lcr_pre, -1) + w:
+            if not getattr(self, "_fallback_counted", False):
+                # one fallback event per flush: the estimate cap in
+                # _latency_ok may already have counted this one
+                self.flush_fallbacks += 1
+            if (self.finality_gate
+                    and self._head_round_min_host() <= max(lcr_pre, -1) + w):
+                # stalled finality gate: every round above the window
+                # top is beyond the head-round minimum, so fame could
+                # not decide it on ANY surface this flush — deferring
+                # in place is run-to-completion, and staying on the
+                # latency kernel is exactly the point of the bounded
+                # window (babble_flush_fallbacks_total counts these)
+                return self._collect_ordered()
             # the W estimate undershot (stale mirrors after a checkpoint
             # restore, or a batch that raised rounds faster than the
             # levels heuristic): rounds above the window top got no
@@ -540,6 +622,26 @@ class TpuHashgraph:
             self.decide_fame()
             return self.find_order()
         return self._collect_ordered()
+
+    def _head_round_min_host(self) -> int:
+        """Host mirror of ops.state.head_round_min_math (same chain
+        and staleness semantics), consulted only on the rare window-
+        undershoot path: the round below which the finality gate can
+        still decide.  INT32_MAX when every minted chain is stale."""
+        base = self.dag.slot_base
+        rnd = self._arr("round")
+        out = None
+        for chain in self.dag.chains:
+            if len(chain) == 0 or not chain.window:
+                hr = -1   # never minted, or tail evicted (device ce
+                          # column 0 is -1 → sentinel round): both stale
+                          # once the fleet is >HORIZON rounds ahead
+            else:
+                hr = int(rnd[chain[-1] - base])
+            if hr + HEAD_GATE_HORIZON < self._max_round_cache:
+                continue
+            out = hr if out is None else min(out, hr)
+        return int(INT32_MAX) if out is None else out
 
     # ------------------------------------------------------------------
     # rolling-window compaction (reference caches.go:45-76 applied to the
@@ -556,6 +658,18 @@ class TpuHashgraph:
         beyond that, syncs get TooLateError, the reference's rolling-cache
         contract).  Chain slots ascend with seq, so the per-creator seq
         windows and the slot prefix stay consistent by construction.
+
+        Per-creator eviction (ISSUE 8): the per-creator retention in (c)
+        is what a SILENT peer weaponizes — its chain head never advances,
+        its retained tail sits early in the slot order, and the
+        contiguous prefix can never move past it, so one dead peer pins
+        eviction (and therefore memory AND fast-forward recovery)
+        fleet-wide for the whole outage.  A creator whose head round has
+        fallen more than ``inactive_rounds`` decided rounds behind lcr
+        is *inactive*: its retention is dropped, its tail evicts with
+        the prefix, and ``dag.evicted_heads`` records its (index, hex)
+        eviction horizon — the anchor its return bootstraps against
+        (verified fast-forward + the continuation insert rule).
 
         Returns the number of evicted slots.  No-ops while host events are
         pending (their parents must stay resolvable until flushed)."""
@@ -576,11 +690,16 @@ class TpuHashgraph:
         counts = np.fromiter(
             (len(c) for c in self.dag.chains), np.int64, self.n
         )
-        ok = (
-            (rr >= 0)
-            & (rnd < new_r_off)
-            & (seq < counts[creator] - self.seq_window)
-        )
+        past_window = seq < counts[creator] - self.seq_window
+        if self.inactive_rounds is not None:
+            inactive = np.zeros(self.n + 1, bool)
+            for c, chain in enumerate(self.dag.chains):
+                if not chain.window:
+                    continue
+                head_round = int(rnd[chain[-1] - base])
+                inactive[c] = head_round < lcr - self.inactive_rounds
+            past_window = past_window | inactive[creator]
+        ok = (rr >= 0) & (rnd < new_r_off) & past_window
         k = int(np.argmin(ok)) if not ok.all() else ne
         if (k < self.compact_min and not force) or (k == 0 and dr == 0):
             return 0
@@ -597,11 +716,31 @@ class TpuHashgraph:
         self._received = {g for g in self._received if g >= base + k}
         self._r_off += dr
         self._view = {}
+        self._evicted_creators_cache = sum(
+            1 for c in self.dag.chains if len(c) and not c.window
+        )
+        if self.cfg.ts32:
+            # rolling ts32 rebase (PR 7 leftover b): the span guard
+            # tracks the LIVE window's timestamp span — the kernel
+            # rebases against the live minimum each flush, so eviction
+            # moving the frontier narrows the span a wall-clock fleet
+            # accumulates (~2 s of ns ticks otherwise trips the guard)
+            ne2 = self.dag.n_events - self.dag.slot_base
+            ts = self._arr("ts")[:ne2]
+            live = self._arr("seq")[:ne2] >= 0
+            if live.any():
+                self._ts_lo = int(ts[live].min())
+                self._ts_hi = int(ts[live].max())
+            else:
+                self._ts_lo = self._ts_hi = None
         if self.consensus_window is not None:
             self.consensus.evict_to(
                 max(self.consensus.start,
                     len(self.consensus) - self.consensus_window)
             )
+            # keep the digest anchored at the trimmed window's start so
+            # fast-forward snapshots of this window stay re-foldable
+            self._digest.evict_to(self.consensus.start)
         return k
 
     def _round_prn(self, r: int) -> int:

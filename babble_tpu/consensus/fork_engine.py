@@ -73,6 +73,8 @@ class ForkHashgraph:
         self.seq_window = seq_window
         self.compact_min = compact_min
         self.consensus: List[str] = []
+        from .digest import CommitDigest
+        self._digest = CommitDigest()
         self.consensus_transactions = 0
         self.last_committed_round_events = 0
         self._received: set = set()     # event hexes already ordered
@@ -208,6 +210,21 @@ class ForkHashgraph:
 
     def consensus_events_count(self) -> int:
         return len(self.consensus)
+
+    # commit-digest surface (verified fast-forward, store/proof.py):
+    # the fork engine's consensus list is append-only, so the rolling
+    # hash chain is position-exact with anchor 0
+
+    @property
+    def commit_digest(self) -> str:
+        return self._digest.head
+
+    @property
+    def commit_length(self) -> int:
+        return self._digest.length
+
+    def commit_digest_at(self, position: int):
+        return self._digest.digest_at(position)
 
     def stats_snapshot(self) -> Dict[str, int]:
         # forked_creators is the operator-facing equivocation signal
@@ -385,6 +402,7 @@ class ForkHashgraph:
         new_events = consensus_sort(new_events, prn)
         for ev in new_events:
             self.consensus.append(ev.hex())
+            self._digest.note(ev.hex())
             self.consensus_transactions += len(ev.transactions)
         lcr = self._lcr_cache
         if lcr >= 1:

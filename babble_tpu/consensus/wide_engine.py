@@ -126,6 +126,10 @@ class WideHashgraph(TpuHashgraph):
         )
 
         self.consensus = OffsetList()
+        from .digest import CommitDigest
+        self._digest = CommitDigest()
+        self.inactive_rounds = None   # per-creator eviction: fused-only
+        self._evicted_creators_cache = 0
         self.consensus_transactions = 0
         self.last_committed_round_events = 0
         self._received: set = set()
@@ -276,6 +280,7 @@ class WideHashgraph(TpuHashgraph):
         new_events = consensus_sort(new_events, self._round_prn)
         for ev in new_events:
             self.consensus.append(ev.hex())
+            self._digest.note(ev.hex())
             self.consensus_transactions += len(ev.transactions)
 
         lcr = self._lcr_cache
@@ -320,6 +325,7 @@ class WideHashgraph(TpuHashgraph):
                 max(self.consensus.start,
                     len(self.consensus) - self.consensus_window)
             )
+            self._digest.evict_to(self.consensus.start)
         return k
 
     # ------------------------------------------------------------------

@@ -56,6 +56,14 @@ class HostDag:
     wire_meta: OffsetList = field(default_factory=OffsetList)
     chains: List[OffsetList] = field(init=False)               # creator -> slots
     pending: List[int] = field(default_factory=list)           # unflushed slots
+    # per-creator eviction horizon: cid -> (index, hex) of the NEWEST
+    # evicted event of that creator (ISSUE 8 per-creator eviction).
+    # When inactivity eviction empties a creator's whole window, this
+    # record is what lets the chain resume: a continuation event naming
+    # the recorded hash as self-parent at the recorded index + 1 is
+    # insertable as a pseudo-root (see insert), and bootstrap adopts
+    # the recorded (index, hex) as the returning node's chain tip.
+    evicted_heads: Dict[int, Tuple[int, str]] = field(default_factory=dict)
 
     def __post_init__(self):
         self.reverse_participants = {v: k for k, v in self.participants.items()}
@@ -98,21 +106,40 @@ class HostDag:
             meta = (-1, -1, -1)
         else:
             sps = self.slot_of.get(sp, -1)
+            continuation = False
             if sps < 0:
-                raise InsertError(
-                    f"self-parent not known (creator already has "
-                    f"{len(chain)} events — possible fork)"
-                    if sp == ""
-                    else f"self-parent not known ({sp[:18]}…)"
-                )
-            if self.events[sps].creator != creator:
+                # Post-horizon chain continuation (ISSUE 8 per-creator
+                # eviction): when inactivity eviction emptied this
+                # creator's whole window, the recorded eviction horizon
+                # (index, hex) of its newest evicted event is the only
+                # surviving anchor.  An event that names EXACTLY that
+                # hash as self-parent at the next contiguous index is
+                # the legitimate resumption of the published chain —
+                # accepted as a pseudo-root (sp slot -1, same as a
+                # checkpoint-restored event whose parents predate the
+                # window).  Anything else stays rejected: the hash
+                # check means a forged "continuation" would need a
+                # preimage of the evicted head's id.
+                horizon = self.evicted_heads.get(cid)
+                if (not chain.window and horizon is not None
+                        and sp != "" and horizon == (event.index - 1, sp)
+                        and event.index == len(chain)):
+                    continuation = True
+                else:
+                    raise InsertError(
+                        f"self-parent not known (creator already has "
+                        f"{len(chain)} events — possible fork)"
+                        if sp == ""
+                        else f"self-parent not known ({sp[:18]}…)"
+                    )
+            if not continuation and self.events[sps].creator != creator:
                 raise InsertError("self-parent has different creator")
             ops = self.slot_of.get(op, -1)
             if ops < 0:
                 # non-root events need both parents (reference requires the
                 # other-parent lookup to succeed, hashgraph.go:381-384)
                 raise InsertError(f"other-parent not known ({op[:18]}…)")
-            if not chain or chain[-1] != sps:
+            if not continuation and (not chain or chain[-1] != sps):
                 raise InsertError("self-parent not last known event by creator")
             if event.index != len(chain):
                 raise InsertError(
@@ -120,7 +147,7 @@ class HostDag:
                 )
             op_ev = self.events[ops]
             meta = (
-                self.events[sps].index,
+                event.index - 1 if continuation else self.events[sps].index,
                 self.participants[op_ev.creator],
                 op_ev.index,
             )
@@ -153,6 +180,11 @@ class HostDag:
         """Drop every slot below ``new_base`` (the engine guarantees they are
         committed and outside every rolling window — see maybe_compact)."""
         for ev in self.events.evict_to(new_base):
+            # eviction horizon: slots ascend with seq within a chain, so
+            # the last write per creator records its newest evicted event
+            self.evicted_heads[self.participants[ev.creator]] = (
+                ev.index, ev.hex()
+            )
             del self.slot_of[ev.hex()]
         self.levels.evict_to(new_base)
         self.sp_slot.evict_to(new_base)
@@ -246,6 +278,13 @@ class HostDag:
                 h = overlay.get((rcid, idx))
                 if h is not None:
                     return h
+            horizon = self.evicted_heads.get(rcid)
+            if horizon is not None and horizon[0] == idx \
+                    and idx < self.chains[rcid].start:
+                # the referenced event was evicted but its (index, hex)
+                # survives as the creator's eviction horizon — exactly
+                # the reference a post-horizon continuation event makes
+                return horizon[1]
             return self.events[self.chains[rcid][idx]].hex()
 
         self_parent = ""

@@ -126,19 +126,106 @@ class FastForwardRequest:
 
 @dataclass
 class FastForwardResponse:
+    """Snapshot plus the responder's SIGNED state proof (ISSUE 8): the
+    signature covers ``(sha256(snapshot), lcr, position, digest)``
+    under the responder's participant key, binding the exact bytes
+    served to a committed frontier any honest peer can attest
+    (store/proof.py).  A proof-less response (``digest == ""``) is what
+    pre-proof peers send; joiners with verification on reject it.
+    Compat is one-directional by design: upgraded joiners still parse
+    pre-proof 2-tuple responses, but pre-proof joiners cannot parse the
+    7-field form — roll out responders last (or the fleet atomically),
+    or a not-yet-upgraded laggard cannot catch up."""
+
     from_addr: str
     snapshot: bytes
+    #: responder's last consensus round at snapshot time
+    lcr: int = -1
+    #: committed-log length the digest covers
+    position: int = 0
+    #: rolling commit digest at ``position`` ("" = no proof attached)
+    digest: str = ""
+    #: ECDSA signature over the proof message
+    sig_r: int = 0
+    sig_s: int = 0
 
     def pack(self) -> bytes:
-        return msgpack.packb([self.from_addr, self.snapshot], use_bin_type=True)
+        return msgpack.packb(
+            [self.from_addr, self.snapshot, self.lcr, self.position,
+             self.digest, self.sig_r, self.sig_s],
+            use_bin_type=True,
+        )
 
     @classmethod
     def unpack(cls, data: bytes) -> "FastForwardResponse":
-        from_addr, snapshot = msgpack.unpackb(data, raw=False)
-        return cls(from_addr=from_addr, snapshot=snapshot)
+        fields = msgpack.unpackb(data, raw=False)
+        if len(fields) == 2:   # pre-proof peers
+            from_addr, snapshot = fields
+            return cls(from_addr=from_addr, snapshot=snapshot)
+        from_addr, snapshot, lcr, position, digest, r, s = fields
+        return cls(from_addr=from_addr, snapshot=snapshot, lcr=int(lcr),
+                   position=int(position), digest=digest,
+                   sig_r=int(r), sig_s=int(s))
 
     def approx_size(self) -> int:
-        return 64 + len(self.snapshot)
+        return 192 + len(self.snapshot)
+
+
+RPC_STATE_PROOF = 3
+
+
+@dataclass
+class StateProofRequest:
+    """Attestation request (verified fast-forward): "co-sign your
+    commit digest at ``position``".  Sent by a fast-forward joiner to
+    peers OTHER than the snapshot responder; ``n//3 + 1`` matching
+    signed digests (responder included) gate snapshot adoption, so a
+    rewritten history needs a byzantine quorum to install."""
+
+    from_addr: str
+    position: int
+
+    def pack(self) -> bytes:
+        return msgpack.packb([self.from_addr, self.position],
+                             use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "StateProofRequest":
+        from_addr, position = msgpack.unpackb(data, raw=False)
+        return cls(from_addr=from_addr, position=int(position))
+
+    def approx_size(self) -> int:
+        return 64
+
+
+@dataclass
+class StateProofResponse:
+    """Attestation: the responder's commit digest at the requested
+    position, signed with its participant key.  ``digest == ""`` means
+    "unknown" — the position is ahead of this peer or rolled off its
+    retained digest history — and never counts toward the quorum."""
+
+    from_addr: str
+    position: int
+    digest: str = ""
+    sig_r: int = 0
+    sig_s: int = 0
+
+    def pack(self) -> bytes:
+        return msgpack.packb(
+            [self.from_addr, self.position, self.digest,
+             self.sig_r, self.sig_s],
+            use_bin_type=True,
+        )
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "StateProofResponse":
+        from_addr, position, digest, r, s = msgpack.unpackb(data, raw=False)
+        return cls(from_addr=from_addr, position=int(position),
+                   digest=digest, sig_r=int(r), sig_s=int(s))
+
+    def approx_size(self) -> int:
+        return 192
 
 
 RPC_PUSH = 2
@@ -209,9 +296,12 @@ FastForwardRequest.RTYPE = RPC_FAST_FORWARD
 FastForwardRequest.RESPONSE_CLS = FastForwardResponse
 PushRequest.RTYPE = RPC_PUSH
 PushRequest.RESPONSE_CLS = PushResponse
+StateProofRequest.RTYPE = RPC_STATE_PROOF
+StateProofRequest.RESPONSE_CLS = StateProofResponse
 
 REQUEST_TYPES = {
     RPC_SYNC: SyncRequest,
     RPC_FAST_FORWARD: FastForwardRequest,
     RPC_PUSH: PushRequest,
+    RPC_STATE_PROOF: StateProofRequest,
 }

@@ -119,6 +119,23 @@ class Config:
     # frames over the multiplexed connection instead of falling back
     # to pull rounds); 0 restores single-frame pushes.
     push_stream_max: int = 16
+    # ---- silent-peer survival (ISSUE 8) ----
+    # Per-creator eviction: a creator whose chain head falls more than
+    # this many DECIDED rounds behind lcr loses its seq-window
+    # retention — its tail evicts, memory stays bounded through the
+    # outage, and its return is forced through (verified) fast-forward.
+    # None disables (one dead peer then pins eviction fleet-wide).
+    # Fused engine only; wide/byzantine engines keep prefix eviction.
+    inactive_rounds: int | None = 32
+    # Verified fast-forward: require the responder's signed state proof
+    # AND ff_proof_quorum matching peer attestations of the committed
+    # frontier before adopting a snapshot.  Off = the pre-proof trust
+    # model (any serving peer can feed a forged state).
+    ff_verify: bool = True
+    # Matching signed digests required to adopt (responder included).
+    # None = n//3 + 1: any such set contains an honest signer while
+    # fewer than a third of participants are byzantine.
+    ff_proof_quorum: int | None = None
     # Durability plane (babble_tpu/wal): "" disables the write-ahead
     # log (the pre-WAL behavior — restarts may re-mint published seqs
     # unless a fresh checkpoint exists).  With a directory set, every

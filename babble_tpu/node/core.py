@@ -69,6 +69,7 @@ class Core:
         registry: Optional[Registry] = None,
         wal: Optional[WriteAheadLog] = None,
         kernel_class: str = "auto",
+        inactive_rounds: Optional[int] = 32,
     ):
         self.id = core_id
         self.kernel_class = kernel_class
@@ -142,6 +143,9 @@ class Core:
                 # the fused path; ROADMAP premature intra-round finality)
                 finality_gate=True,
                 kernel_class=kernel_class,
+                # per-creator eviction (ISSUE 8): a peer silent for
+                # this many decided rounds stops pinning the window
+                inactive_rounds=inactive_rounds,
             )
         self.byzantine = byzantine
         self._apply_live_engine_policy()
@@ -189,6 +193,9 @@ class Core:
         # past it.
         self._probing = False
         self._probe_seen: set = set()
+        #: transactions of an unrecoverable own-chain suffix discarded
+        #: by the last horizon bootstrap (node re-pools them)
+        self.last_bootstrap_lost_txs: List[bytes] = []
         # supermajority is 2n//3+1 members counting ourselves, so the
         # probe needs 2n//3 PEER answers — 0 for a single-participant
         # fleet, where our own durable state is the only authority
@@ -420,23 +427,79 @@ class Core:
                 f"bootstrap engine kind '{engine_mode(engine)}' does "
                 f"not match this core's '{engine_mode(self.hg)}'"
             )
+        # flush_fallbacks backs a *_total metric series read through
+        # self.hg: carry the old engine's count across the swap or the
+        # monotone counter goes backwards at every fast-forward
+        if hasattr(engine, "flush_fallbacks"):
+            engine.flush_fallbacks = (
+                getattr(engine, "flush_fallbacks", 0)
+                + getattr(self.hg, "flush_fallbacks", 0)
+            )
         if self.byzantine:
             self._bootstrap_fork(engine)
+            self._note_ff_adopted()
             return
         cid = self.participants[self.pub_hex]
         chain = engine.dag.chains[cid]
+        horizon = engine.dag.evicted_heads.get(cid)
         if chain and not chain.window:
-            raise ValueError(
-                "snapshot window holds none of our own chain tail"
-            )
-        snap_seq = engine.dag.events[chain[-1]].index if chain else -1
+            # Per-creator eviction (ISSUE 8): the fleet evicted our
+            # ENTIRE retained tail during the outage — legitimate
+            # exactly when the snapshot records our eviction horizon at
+            # the chain's logical tip.  The horizon's (index, hex) IS
+            # the fleet's view of our published chain head: we resume
+            # from it (continuation events are insertable fleet-wide
+            # via the horizon rule in HostDag.insert).  A window-less
+            # chain with no matching horizon is still a corrupt
+            # snapshot.
+            if horizon is None or horizon[0] != len(chain) - 1:
+                raise ValueError(
+                    "snapshot window holds none of our own chain tail "
+                    "and records no matching eviction horizon"
+                )
+            snap_seq = horizon[0]
+        else:
+            snap_seq = engine.dag.events[chain[-1]].index if chain else -1
+        lost_txs: List[bytes] = []
+        tail_lost = False
         if self.seq > snap_seq:
-            self._replay_own_tail(engine, cid, snap_seq)
-        if chain:
-            head_ev = engine.dag.events[engine.dag.chains[cid][-1]]
+            if chain and not chain.window:
+                # Horizon rejoin: replay our local tail as far as the
+                # adopted window allows (the first event rides the
+                # continuation rule).  A suffix whose ancestry the
+                # whole fleet evicted is UNRECOVERABLE — no other peer
+                # can serve a snapshot that still holds it — so
+                # refusing here (the strict path below) would wedge the
+                # node forever.  The suffix is discarded, its
+                # transactions surface for re-mint, and the seq probe
+                # re-arms: minting stays deferred until a supermajority
+                # of sync partners confirm nobody holds a higher seq of
+                # ours, so a fresh event can reuse the first lost index
+                # without equivocation risk (same residual trust as the
+                # WAL-missing probe).
+                lost_txs, tail_lost = self._replay_continuation_tail(
+                    engine, cid, snap_seq
+                )
+            else:
+                # in-window tail: the snapshot peer is merely behind —
+                # a refusal keeps the old engine and a later snapshot
+                # (or plain gossip) reconciles losslessly
+                self._replay_own_tail(engine, cid, snap_seq)
+        chain = engine.dag.chains[cid]
+        if chain and chain.window:
+            head_ev = engine.dag.events[chain[-1]]
             self.hg = engine
             self.head = head_ev.hex()
             self.seq = head_ev.index
+        elif chain:
+            # window still empty after reconciliation: our local head
+            # is at or below the fleet's horizon — adopt the horizon as
+            # our chain tip.  Those seqs were published under our key
+            # (every peer ordered them before evicting), so the next
+            # mint extends at horizon+1 instead of ever re-minting.
+            self.hg = engine
+            self.head = horizon[1]
+            self.seq = horizon[0]
         else:
             # the snapshot knows nothing of us (our pre-partition events
             # never propagated): mint a fresh root so syncs have a head
@@ -444,8 +507,58 @@ class Core:
             self.head = ""
             self.seq = -1
             self.init()
+        if tail_lost:
+            # unrecoverable suffix discarded: allow the next mint to
+            # reuse its first index — guarded by the re-armed probe
+            self._min_next_seq = self.seq + 1
+            self._probing = self._probe_quorum > 0
+            self._probe_seen = set()
+        self.last_bootstrap_lost_txs = lost_txs
         self._apply_live_engine_policy()
         self._rebind_engine_registry()
+        self._note_ff_adopted()
+
+    def _replay_continuation_tail(
+        self, engine: TpuHashgraph, cid: int, snap_seq: int
+    ) -> Tuple[List[bytes], bool]:
+        """Replay our own events past the adopted snapshot's eviction
+        horizon, as far as the new window can resolve them (the first
+        rides the continuation insert rule).  Returns ``(lost_txs,
+        tail_lost)``: the transactions of the unrecoverable suffix
+        (events whose other-parents the whole fleet evicted) so the
+        node can re-pool them for a fresh mint, and whether any suffix
+        was discarded at all (re-arms the seq probe even when the lost
+        events carried no transactions)."""
+        old_chain = self.hg.dag.chains[cid]
+        lost: List[bytes] = []
+        broken = False
+        for q in range(snap_seq + 1, self.seq + 1):
+            if q < old_chain.start:
+                # locally evicted too: nothing left to replay or re-pool
+                broken = True
+                continue
+            ev = self.hg.dag.events[old_chain[q]]
+            if not broken:
+                try:
+                    engine.insert_event(ev)
+                    continue
+                except ValueError:
+                    broken = True
+            lost.extend(ev.transactions)
+        return lost, broken
+
+    def _note_ff_adopted(self) -> None:
+        """WAL-aware fast-forward receipts (PR 5 leftover): the adopted
+        snapshot supersedes everything the WAL recorded — replaying
+        those records over the new window would just fail on the next
+        restart (their ancestry predates the adopted window) while the
+        lost head receipt would force a needless seq probe.  Prune the
+        records the snapshot now covers and stamp the receipt with the
+        adopted head; a crash before the next checkpoint then recovers
+        by fast-forwarding again, mint floor intact."""
+        if self.wal is not None:
+            self.wal.checkpointed(self.seq, self.head)
+        self._min_next_seq = max(self._min_next_seq, self.seq + 1)
 
     def _bootstrap_fork(self, engine) -> None:
         """Byzantine-mode bootstrap (VERDICT r4 missing #5): adopt a

@@ -48,6 +48,8 @@ from ..net.commands import (
     FastForwardResponse,
     PushRequest,
     PushResponse,
+    StateProofRequest,
+    StateProofResponse,
     SyncRequest,
     SyncResponse,
 )
@@ -78,6 +80,16 @@ _KERNEL_CLASSES = ("latency", "throughput")
 #: encode each time.  Deep catch-up belongs to pull/fast-forward.
 PUSH_MAX_EVENTS = 512
 PUSH_MAX_BYTES = 4 * 1024 * 1024
+
+
+class FFProofError(Exception):
+    """A fast-forward snapshot failed signed-state-proof verification
+    (missing/invalid responder signature, digest inconsistent with the
+    snapshot bytes, or attestation quorum not reached).  The joiner
+    refuses the snapshot LOUDLY — babble_ff_proof_rejects_total — and
+    retries against another peer on a later gossip round, instead of
+    silently installing a forged state (the FAST'18 protocol-aware-
+    recovery failure mode)."""
 
 
 def _push_prefix(diff: List[Event]) -> List[Event]:
@@ -130,6 +142,10 @@ class Node:
         self._addr_cid = {
             p.net_addr: participants[p.pub_key_hex] for p in peers
         }
+        #: gossip address -> participant pub hex (fast-forward proof
+        #: verification resolves the responder's/attester's key by the
+        #: address the RPC went to)
+        self._addr_pub = {p.net_addr: p.pub_key_hex for p in peers}
 
         # durability plane: the WAL constructor performs recovery
         # (scan + truncate-at-first-bad-record); Core replays the
@@ -156,6 +172,7 @@ class Node:
             wide_caps=conf.wide_caps,
             registry=self.registry,
             kernel_class=conf.kernel_class,
+            inactive_rounds=conf.inactive_rounds,
         )
         # AOT compile cache (ops/aot.py): pre-compile the recorded
         # live-flush shapes at boot — against the persistent XLA cache a
@@ -243,6 +260,11 @@ class Node:
         self._m_ff_seconds = m.histogram(
             "babble_fast_forward_seconds",
             "fast-forward fetch+validate+bootstrap wall time")
+        self._m_ff_rejects = m.counter(
+            "babble_ff_proof_rejects_total",
+            "fast-forward snapshots refused because the signed state "
+            "proof was missing, invalid, inconsistent with the snapshot "
+            "bytes, or short of the attestation quorum")
         self._m_sync_seconds = m.histogram(
             "babble_sync_seconds",
             "insert+mint wall time per applied sync response")
@@ -318,6 +340,20 @@ class Node:
             "babble_gossip_backoff_creators",
             "creators under per-creator resync backoff (byzantine mode)",
         ).set_function(lambda: len(self.core._creator_backoff))
+        # read through self.core.hg so both survive fast-forward engine
+        # swaps; host-mirror reads only, no device sync on scrape
+        m.gauge(
+            "babble_evicted_creators",
+            "creators whose retained tail was evicted for inactivity "
+            "(their return must bootstrap via verified fast-forward)",
+        ).set_function(
+            lambda: getattr(self.core.hg, "_evicted_creators_cache", 0))
+        m.gauge(
+            "babble_flush_fallbacks_total",
+            "flushes whose latency window could not cover the undecided "
+            "round span (stalled-gate deferrals + throughput degrades)",
+        ).set_function(
+            lambda: getattr(self.core.hg, "flush_fallbacks", 0))
         self._loop_probe = LoopLagProbe(m)
         # transport-level series (bytes in/out, pool reuse) land on the
         # same /metrics page when the transport supports instrumentation
@@ -777,6 +813,8 @@ class Node:
         try:
             if isinstance(req, FastForwardRequest):
                 resp = await self._process_fast_forward_request(req)
+            elif isinstance(req, StateProofRequest):
+                resp = await self._process_state_proof_request(req)
             elif isinstance(req, PushRequest):
                 resp = await self._process_push_request(req)
             else:
@@ -872,20 +910,65 @@ class Node:
         behind the reference's rolling caches can never rejoin).  In
         byzantine mode the snapshot ships branch tips + divergence
         points + detection-relevant seeds, so the rejoining node resumes
-        fork-aware with the same equivocation knowledge we hold."""
+        fork-aware with the same equivocation knowledge we hold.
+
+        The response carries our SIGNED state proof (store/proof.py):
+        the signature binds the exact snapshot bytes to our committed
+        frontier ``(lcr, position, digest)``, which any honest peer can
+        attest — the joiner's quorum check is what makes a forged
+        snapshot rejectable instead of silently installable."""
         from ..store.checkpoint import snapshot_bytes
+        from ..store.proof import sign_snapshot_proof, snapshot_hash
 
         loop = asyncio.get_running_loop()
         async with self.core_lock:
             snap = await loop.run_in_executor(
                 None, snapshot_bytes, self.core.hg
             )
+            hg = self.core.hg
+            lcr = int(hg._lcr_cache)
+            position = hg.commit_length
+            digest = hg.commit_digest
+            r, s = sign_snapshot_proof(
+                self.core.key, snapshot_hash(snap), lcr, position, digest
+            )
         self.logger.info(
-            "served fast-forward snapshot (%d bytes) to %s",
-            len(snap), req.from_addr,
+            "served fast-forward snapshot (%d bytes, frontier %d) to %s",
+            len(snap), position, req.from_addr,
         )
         return FastForwardResponse(
-            from_addr=self.transport.local_addr(), snapshot=snap
+            from_addr=self.transport.local_addr(), snapshot=snap,
+            lcr=lcr, position=position, digest=digest, sig_r=r, sig_s=s,
+        )
+
+    async def _process_state_proof_request(
+        self, req: StateProofRequest
+    ) -> StateProofResponse:
+        """Attest our commit digest at the requested position (a
+        fast-forward joiner's quorum check).  When the position is
+        ahead of our own frontier we attest what we CAN vouch for —
+        our current frontier — and the joiner re-folds the snapshot
+        window to compare.  Positions rolled off the retained digest
+        history answer with an empty digest, which never counts toward
+        anyone's quorum."""
+        from ..store.proof import sign_attestation
+
+        async with self.core_lock:
+            hg = self.core.hg
+            digest = None
+            pos = req.position
+            if pos >= 0 and hasattr(hg, "commit_digest_at"):
+                pos = min(pos, hg.commit_length)
+                digest = hg.commit_digest_at(pos)
+            if digest is None:
+                return StateProofResponse(
+                    from_addr=self.transport.local_addr(),
+                    position=req.position,
+                )
+            r, s = sign_attestation(self.core.key, pos, digest)
+        return StateProofResponse(
+            from_addr=self.transport.local_addr(), position=pos,
+            digest=digest, sig_r=r, sig_s=s,
         )
 
     # ------------------------------------------------------------------
@@ -995,14 +1078,119 @@ class Node:
                 f"fast-forward snapshot capacities out of bounds: {cap}"
             )
 
+    def _ff_proof_quorum(self) -> int:
+        """Matching signed digests required to adopt a snapshot
+        (responder included): with fewer than a third of participants
+        byzantine, any n//3 + 1 matching signers include an honest
+        node, so a rewritten history can never gather a quorum."""
+        if self.conf.ff_proof_quorum is not None:
+            return max(1, self.conf.ff_proof_quorum)
+        return len(self.core.participants) // 3 + 1
+
+    def _verify_ff_responder(self, peer_addr: str,
+                             resp: FastForwardResponse) -> None:
+        """Cheap first gate: the responder's signature must bind the
+        exact snapshot bytes to the claimed frontier before anything is
+        parsed or any peer is bothered."""
+        from ..store.proof import snapshot_hash, verify_snapshot_proof
+
+        pub = self._addr_pub.get(peer_addr)
+        if pub is None:
+            raise FFProofError(f"responder {peer_addr} is not a known peer")
+        if not resp.digest:
+            raise FFProofError("response carries no signed state proof")
+        if not verify_snapshot_proof(
+            pub, snapshot_hash(resp.snapshot), resp.lcr, resp.position,
+            resp.digest, resp.sig_r, resp.sig_s,
+        ):
+            raise FFProofError("responder proof signature invalid")
+
+    async def _verify_ff_quorum(self, peer_addr: str,
+                                resp: FastForwardResponse,
+                                engine) -> None:
+        """Gather the attestation quorum for the snapshot's committed
+        frontier.  Attesters behind the responder answer at their OWN
+        frontier (StateProofResponse.position <= requested); those are
+        checked by re-folding the snapshot's consensus window up to
+        that position over its digest anchor — so a lagging-but-honest
+        fleet still reaches quorum, while any rewrite at or below an
+        attested position mismatches some honest signer.  (Commits
+        beyond every honest attester's current frontier are not yet
+        quorum-verifiable — a forgery confined there defers detection
+        to the first post-bootstrap divergence, the residual any
+        bootstrap protocol under partial synchrony carries.)  Raises
+        FFProofError when the quorum cannot be reached."""
+        from ..consensus.digest import fold
+        from ..store.proof import verify_attestation
+
+        needed = self._ff_proof_quorum()
+        have = 1   # the responder's own signature
+        local = self.transport.local_addr()
+        dg = engine._digest
+        window = list(engine.consensus)
+        start = getattr(engine.consensus, "start", 0)
+        # every attester is asked CONCURRENTLY (a joiner fast-forwards
+        # exactly when parts of the fleet may be unreachable — serial
+        # requests would stack one tcp_timeout per dead peer), and the
+        # answers are evaluated in sorted-address order so the count is
+        # deterministic under the chaos runner
+        attesters = [
+            peer for peer in
+            sorted(p.net_addr for p in self.peer_selector.peers())
+            if peer != peer_addr and peer != local
+        ]
+        answers = await asyncio.gather(
+            *(self.transport.request(
+                peer,
+                StateProofRequest(from_addr=local,
+                                  position=resp.position),
+                timeout=self.conf.tcp_timeout,
+            ) for peer in attesters),
+            return_exceptions=True,
+        )
+        for peer, att in zip(attesters, answers):
+            if have >= needed:
+                break
+            if isinstance(att, BaseException):
+                if isinstance(att, asyncio.CancelledError):
+                    raise att
+                self.logger.debug(
+                    "attestation from %s failed: %s", peer, att)
+                continue
+            apub = self._addr_pub.get(peer)
+            if not att.digest or apub is None \
+                    or att.position > resp.position:
+                continue
+            if att.position == resp.position:
+                expected = resp.digest
+            elif (dg.anchor is not None and dg.anchor_pos == start
+                    and start <= att.position <= start + len(window)):
+                expected = fold(dg.anchor, window[: att.position - start])
+            else:
+                continue   # attester frontier below the snapshot window
+            if att.digest == expected and verify_attestation(
+                apub, att.position, att.digest, att.sig_r, att.sig_s
+            ):
+                have += 1
+        if have < needed:
+            raise FFProofError(
+                f"attestation quorum not reached: {have}/{needed} "
+                f"matching signed digests for frontier "
+                f"({resp.position}, {resp.digest[:12]}…)"
+            )
+
     async def _fast_forward(self, peer_addr: str) -> None:
         """Catch-up: fetch a snapshot and restart consensus from it.
 
-        Trust model: event signatures in the snapshot are re-verified;
-        the consensus decisions ride on trust in the serving peer (the
-        babbleio fast-sync assumption — signed state proofs are the
-        known hardening).  Pooled transactions survive the swap and ride
-        the next self-event."""
+        Trust model (ISSUE 8): event signatures in the snapshot are
+        re-verified, AND the snapshot must carry the responder's signed
+        state proof over ``(snapshot_hash, lcr, position, digest)``
+        co-attested by an n//3+1 quorum (``_verify_ff_proof``), with
+        the consensus window re-folded against the signed digest after
+        restore — a forged snapshot is rejected loudly
+        (babble_ff_proof_rejects_total) instead of silently installed.
+        Pooled transactions survive the swap and ride the next
+        self-event."""
         from ..store.checkpoint import engine_mode, load_snapshot
 
         if self._fast_forwarding:
@@ -1016,6 +1204,8 @@ class Node:
                 FastForwardRequest(from_addr=self.transport.local_addr()),
                 timeout=max(self.conf.tcp_timeout, 30.0),
             )
+            if self.conf.ff_verify:
+                self._verify_ff_responder(peer_addr, resp)
             # local policy overrides whatever the peer serialized — a
             # snapshot must not disable our signature checks or replace
             # our memory bounds
@@ -1059,24 +1249,62 @@ class Node:
                     # e_cap; the peer's serialized values must not survive
                     "compact_min": None,
                     "round_margin": 2,
+                    # LOCAL inactivity policy, not the peer's: a hostile
+                    # round count here could freeze our window exactly
+                    # like a hostile round_margin.  "Disabled" is spelled
+                    # 0, NOT None — None is _pol's absent-key sentinel
+                    # and would silently fall back to the peer's value
+                    "inactive_rounds": (
+                        0 if self.conf.inactive_rounds is None
+                        else self.conf.inactive_rounds
+                    ),
                 }
             loop = asyncio.get_running_loop()
-            async with self.core_lock:
-                # membership + capacity bounds are enforced INSIDE
-                # load_snapshot on the declared meta and the npy headers,
-                # before any array decompresses or any signature verifies —
-                # a hostile snapshot must cost nothing to reject
-                engine = await loop.run_in_executor(
-                    None,
-                    lambda: load_snapshot(
-                        resp.snapshot,
-                        policy=policy,
-                        expected_participants=self.core.participants,
-                        max_caps=self.ff_max_caps(),
-                    ),
+            # membership + capacity bounds are enforced INSIDE
+            # load_snapshot on the declared meta and the npy headers,
+            # before any array decompresses or any signature verifies —
+            # a hostile snapshot must cost nothing to reject.  The load
+            # is pure construction (no core state), so it runs OUTSIDE
+            # the core lock, as does the attestation round-trip.
+            engine = await loop.run_in_executor(
+                None,
+                lambda: load_snapshot(
+                    resp.snapshot,
+                    policy=policy,
+                    expected_participants=self.core.participants,
+                    max_caps=self.ff_max_caps(),
+                ),
+            )
+            if self.conf.ff_verify:
+                # local half of the proof: the restored engine's
+                # committed window must re-fold to the digest the
+                # responder signed — a forger that kept the honest
+                # digest while rewriting the window is caught here,
+                # before any peer is bothered for an attestation
+                from ..store.proof import verify_snapshot_digest
+
+                err = verify_snapshot_digest(
+                    engine, resp.digest, resp.position
                 )
+                if err is not None:
+                    raise FFProofError(err)
+                await self._verify_ff_quorum(peer_addr, resp, engine)
+            async with self.core_lock:
                 self.validate_ff_snapshot(engine)
                 self.core.bootstrap(engine)
+                lost = self.core.last_bootstrap_lost_txs
+                if lost:
+                    # an unrecoverable own-chain suffix was discarded
+                    # at the horizon (Core._replay_continuation_tail):
+                    # its transactions re-enter the pool and ride the
+                    # next mint under fresh, probe-guarded indexes
+                    self._requeue(list(lost))
+                    self.core.last_bootstrap_lost_txs = []
+                    self.logger.warning(
+                        "fast-forward discarded %d unrecoverable "
+                        "own-chain transactions; re-pooled for re-mint",
+                        len(lost),
+                    )
                 if (engine_mode(engine) == "byzantine"
                         and self.conf.fork_caps):
                     # snapshots carry no capacity hints: without the
@@ -1105,6 +1333,14 @@ class Node:
                     self.logger.warning(
                         "app fast-forward hook failed: %s", e
                     )
+        except FFProofError as e:
+            # a forged (or unprovable) snapshot: refuse loudly and keep
+            # the current engine — the next too_late gossip retries the
+            # fast-forward against another (honest) peer
+            self._m_ff_rejects.inc()
+            self.logger.warning(
+                "fast-forward snapshot from %s REJECTED: %s", peer_addr, e
+            )
         except Exception as e:
             self._m_sync_errors.inc()
             self.logger.warning(

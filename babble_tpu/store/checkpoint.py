@@ -63,7 +63,18 @@ def _build_meta(engine: TpuHashgraph) -> dict:
         "policy": [
             engine.auto_compact, engine.seq_window, engine.round_margin,
             engine.compact_min, engine.consensus_window,
+            engine.inactive_rounds,
         ],
+        # per-creator eviction horizons (ISSUE 8): the (index, hex)
+        # anchor a creator's post-eviction chain continuation resumes
+        # from — first-class state, not re-derivable from the window
+        "evicted_heads": sorted(
+            [cid, idx, hx] for cid, (idx, hx) in dag.evicted_heads.items()
+        ),
+        # rolling commit digest (verified fast-forward): the attestable
+        # frontier + its window anchor must survive restart or a
+        # resumed responder could neither attest nor serve proofs
+        "digest": engine._digest.to_meta(),
         "slot_base": dag.slot_base,
         "events": [_pack_event(ev) for ev in dag.events],  # window, slot order
         "levels": list(dag.levels),
@@ -240,6 +251,7 @@ def _build_fork_meta(engine) -> dict:
         "r_off": dag.r_off,
         "evicted": dag.evicted,
         "consensus": list(engine.consensus),
+        "digest": engine._digest.to_meta(),
         "consensus_transactions": engine.consensus_transactions,
         "last_committed_round_events": engine.last_committed_round_events,
         "received": sorted(engine._received),
@@ -371,6 +383,58 @@ def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
     for col, s in meta["chain_tip"]:
         if not (0 <= col < b and 0 <= s < ne):
             raise ValueError("snapshot chain tip out of range")
+    from ..consensus.digest import CommitDigest
+    CommitDigest.check_meta(meta.get("digest"))
+
+
+def _check_host_meta(meta: dict) -> None:
+    """Hostile-snapshot bounds for the ISSUE-8 host fields on the
+    fused/wide path (the byzantine twin lives in _check_fork_meta):
+    eviction horizons must be per-creator unique, in participant range
+    and strictly below the declared chain windows, and the serialized
+    commit digest must pass CommitDigest.check_meta — all before any
+    object is built from the snapshot."""
+    from ..consensus.digest import CommitDigest
+
+    n = len(meta["participants"])
+    # 6th policy entry (inactive_rounds): the override normally masks
+    # it, but local-checkpoint restores and absent override keys fall
+    # back here — a hostile value must not freeze the window (huge) or
+    # TypeError inside maybe_compact (non-int)
+    if len(meta["policy"]) > 5:
+        ir = meta["policy"][5]
+        if ir is not None and (
+                not isinstance(ir, int) or not (0 <= ir <= 1 << 20)):
+            raise ValueError(
+                f"snapshot policy inactive_rounds={ir!r} out of bounds"
+            )
+    heads = meta.get("evicted_heads", [])
+    if not isinstance(heads, (list, tuple)) or len(heads) > n:
+        raise ValueError("snapshot evicted_heads out of bounds")
+    seen = set()
+    chains = meta["chains"]
+    for item in heads:
+        cid, idx, hx = item
+        if not isinstance(cid, int) or not (0 <= cid < n) or cid in seen:
+            raise ValueError(
+                f"snapshot evicted_heads creator {cid!r} out of range"
+            )
+        seen.add(cid)
+        if not isinstance(idx, int) or not (0 <= idx <= 1 << 48):
+            raise ValueError(
+                f"snapshot evicted_heads index {idx!r} out of bounds"
+            )
+        if not isinstance(hx, str) or not (8 <= len(hx) <= 128):
+            raise ValueError("snapshot evicted_heads hash malformed")
+        # the horizon names an EVICTED event: it must sit strictly
+        # below that creator's declared chain window, or a hostile
+        # snapshot could shadow a live event with a forged horizon
+        if cid < len(chains) and idx >= int(chains[cid][0]):
+            raise ValueError(
+                f"snapshot evicted_heads[{cid}]={idx} not below the "
+                f"chain window start {chains[cid][0]}"
+            )
+    CommitDigest.check_meta(meta.get("digest"))
 
 
 def _pol(policy: dict, key: str, snap_val):
@@ -465,6 +529,9 @@ def _restore_fork_engine(
     dag.r_off = int(meta["r_off"])
     dag.evicted = evicted
     engine.consensus = list(meta["consensus"])
+    from ..consensus.digest import CommitDigest
+
+    engine._digest = CommitDigest.from_meta(meta.get("digest"))
     engine.consensus_transactions = int(meta["consensus_transactions"])
     engine.last_committed_round_events = int(
         meta["last_committed_round_events"]
@@ -576,6 +643,7 @@ def load_snapshot(
                         "signature"
                     )
         return engine
+    _check_host_meta(meta)
     cfg = DagConfig(*meta["cfg"])
     if max_caps is not None:
         max_e, max_s, max_r = max_caps
@@ -681,8 +749,14 @@ def _restore_engine(
     # the local node's values on the network path (load_snapshot)
     cfg = DagConfig(*meta["cfg"])
     auto_compact, seq_window, round_margin, compact_min, cons_window = (
-        meta["policy"]
+        meta["policy"][:5]
     )
+    # 6th policy entry (per-creator eviction, ISSUE 8) absent on
+    # pre-PR checkpoints: fall back to the engine's own default.  The
+    # policy override spells "disabled" as 0 (None is _pol's absent-key
+    # sentinel); the engine spells it None — map at the boundary.
+    snap_ir = meta["policy"][5] if len(meta["policy"]) > 5 else 32
+    ir = _pol(policy, "inactive_rounds", snap_ir)
     engine = TpuHashgraph(
         participants,
         commit_callback=commit_callback,
@@ -695,6 +769,7 @@ def _restore_engine(
         round_margin=_pol(policy, "round_margin", round_margin),
         compact_min=_pol(policy, "compact_min", compact_min),
         consensus_window=_pol(policy, "consensus_window", cons_window),
+        inactive_rounds=None if not ir else int(ir),
     )
     engine.cfg = cfg
 
@@ -732,9 +807,20 @@ def _restore_host(engine, meta: dict) -> None:
         OffsetList(items, start) for start, items in meta["chains"]
     ]
     dag.pending = []  # the device tensors already contain them
+    dag.evicted_heads = {
+        int(cid): (int(idx), str(hx))
+        for cid, idx, hx in meta.get("evicted_heads", [])
+    }
+    # the window's emptied chains define the evicted-creator gauge
+    engine._evicted_creators_cache = sum(
+        1 for c in dag.chains if len(c) and not c.window
+    )
 
     cons_start, cons_items = meta["consensus"]
     engine.consensus = OffsetList(cons_items, cons_start)
+    from ..consensus.digest import CommitDigest
+
+    engine._digest = CommitDigest.from_meta(meta.get("digest"))
     engine.consensus_transactions = meta["consensus_transactions"]
     engine.last_committed_round_events = meta["last_committed_round_events"]
     engine._ordered_total = meta["ordered_total"]
@@ -761,7 +847,7 @@ def _restore_wide_engine(
     }
     cfg = DagConfig(*meta["cfg"])
     auto_compact, seq_window, round_margin, compact_min, cons_window = (
-        meta["policy"]
+        meta["policy"][:5]
     )
     # the wide engine's in-window chain depth must stay under s_cap:
     # clamp whatever seq_window the policy/snapshot produced, exactly

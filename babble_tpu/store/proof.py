@@ -1,0 +1,143 @@
+"""Signed state proofs for fast-forward bootstrap (ISSUE 8 tentpole b).
+
+Snapshot trust used to be the babbleio fast-sync assumption: the joiner
+re-verifies every event SIGNATURE in the window, but the consensus
+decisions (rounds, fame, committed order) ride on trust in the single
+serving peer — the protocol-aware-recovery failure mode (Alagappan et
+al., FAST'18): one byzantine bootstrap peer can feed a forged state
+that the joiner silently installs.
+
+The proof scheme closes that to the honest-quorum assumption consensus
+already makes:
+
+- every engine maintains a rolling **commit digest** — a hash chain
+  over the committed order, identical across honest nodes at every
+  position (consensus/digest.py);
+- a fast-forward responder signs ``(snapshot_hash, lcr, position,
+  digest)`` with its participant key (``sign_snapshot_proof``) — the
+  proof binds the exact bytes served to a specific committed frontier;
+- any peer can attest ``(position, digest)`` from its own chain
+  (``sign_attestation``), and the joiner requires ``n//3 + 1`` matching
+  attestations (responder included) before adopting — at most ``f <
+  n/3`` byzantine signers means any f+1 matching set contains an honest
+  node, so a rewritten history can never gather a quorum;
+- the joiner additionally re-folds the snapshot's consensus window over
+  its digest anchor (``verify_snapshot_digest``): a forger that keeps
+  the honest digest while permuting the window is caught locally,
+  before any network round-trip.
+
+A rejected snapshot is refused LOUDLY (``babble_ff_proof_rejects_total``)
+and the joiner falls back to another peer on its next gossip round.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from ..crypto import keys as crypto_keys
+from ..crypto.keys import KeyPair, sha256
+
+_SNAPSHOT_TAG = b"babble-ff-snapshot:v1"
+_ATTEST_TAG = b"babble-ff-attest:v1"
+
+
+def snapshot_hash(snapshot: bytes) -> bytes:
+    return sha256(snapshot)
+
+
+def _snapshot_msg(snap_hash: bytes, lcr: int, position: int,
+                  digest: str) -> bytes:
+    return sha256(
+        _SNAPSHOT_TAG + snap_hash
+        + struct.pack(">qQ", lcr, position) + digest.encode("ascii")
+    )
+
+
+def _attest_msg(position: int, digest: str) -> bytes:
+    return sha256(
+        _ATTEST_TAG + struct.pack(">Q", position) + digest.encode("ascii")
+    )
+
+
+def sign_snapshot_proof(key: KeyPair, snap_hash: bytes, lcr: int,
+                        position: int, digest: str):
+    """Responder side: sign the (snapshot, frontier) binding."""
+    return key.sign_digest(_snapshot_msg(snap_hash, lcr, position, digest))
+
+
+def verify_snapshot_proof(pub_hex: str, snap_hash: bytes, lcr: int,
+                          position: int, digest: str,
+                          r: int, s: int) -> bool:
+    try:
+        pub = crypto_keys.from_pub_bytes(
+            crypto_keys.pub_hex_to_bytes(pub_hex)
+        )
+        return crypto_keys.verify(
+            pub, _snapshot_msg(snap_hash, lcr, position, digest), r, s
+        )
+    except Exception:
+        return False
+
+
+def sign_attestation(key: KeyPair, position: int, digest: str):
+    """Attester side: co-sign a committed frontier you hold yourself."""
+    return key.sign_digest(_attest_msg(position, digest))
+
+
+def verify_attestation(pub_hex: str, position: int, digest: str,
+                       r: int, s: int) -> bool:
+    try:
+        pub = crypto_keys.from_pub_bytes(
+            crypto_keys.pub_hex_to_bytes(pub_hex)
+        )
+        return crypto_keys.verify(pub, _attest_msg(position, digest), r, s)
+    except Exception:
+        return False
+
+
+def verify_snapshot_digest(engine, digest: str,
+                           position: int) -> Optional[str]:
+    """Local half of snapshot verification: the restored engine's
+    commit-digest state must be internally consistent AND match the
+    signed proof.  Returns an error string (reject the snapshot) or
+    None.  Runs before any attestation round-trip — a forgery that is
+    cheap to detect must be cheap to reject."""
+    from ..consensus.digest import fold
+
+    dg = getattr(engine, "_digest", None)
+    if dg is None:
+        return "snapshot engine carries no commit digest"
+    if dg.length != position or dg.head != digest:
+        return (
+            f"snapshot digest frontier ({dg.length}, {dg.head[:12]}…) "
+            f"does not match the signed proof ({position}, {digest[:12]}…)"
+        )
+    window = list(engine.consensus)
+    start = getattr(engine.consensus, "start", 0)
+    if start + len(window) != dg.length:
+        return (
+            f"snapshot consensus window ({start}+{len(window)} entries) "
+            f"inconsistent with digest length {dg.length}"
+        )
+    if dg.anchor is None or dg.anchor_pos != start:
+        # An un-anchorable window would skip the re-fold — which is
+        # exactly the dodge a forger wants (keep the honest head, set
+        # anchor=None, permute the window; the quorum then co-signs a
+        # head that no longer covers what the joiner adopts).  Honest
+        # responders essentially never land here: evict_to only loses
+        # its anchor when the trimmed window outruns RECENT_POSITIONS
+        # (consensus_window > 8192).  Reject; the joiner retries
+        # another peer.
+        return (
+            "snapshot digest does not anchor its consensus window "
+            f"(anchor_pos {dg.anchor_pos} vs window start {start}) — "
+            "the committed window cannot be verified against the "
+            "signed digest"
+        )
+    if fold(dg.anchor, window) != dg.head:
+        return (
+            "snapshot consensus window does not re-fold to the signed "
+            "digest — committed history was rewritten"
+        )
+    return None

@@ -1,0 +1,34 @@
+"""Fixture: unverified-snapshot-adopt — engines built from
+peer-supplied snapshot bytes with no state-proof verification anywhere
+in the call closure.  A byzantine bootstrap peer can feed these paths a
+forged committed history and the node silently installs it (the
+FAST'18 protocol-aware-recovery failure mode)."""
+
+from babble_tpu.store.checkpoint import load_snapshot
+
+
+class TrustingNode:
+    def __init__(self, core):
+        self.core = core
+
+    async def catch_up(self, resp):
+        engine = load_snapshot(  # MARK: unverified-snapshot-adopt
+            resp.snapshot, policy={"verify_signatures": True},
+        )
+        self.core.bootstrap(engine)
+
+    async def catch_up_via_helper(self, resp):
+        # the adoption hides in a helper: the closure still lacks any
+        # verification reach
+        engine = load_snapshot(  # MARK: unverified-snapshot-adopt
+            resp.snapshot,
+        )
+        self._adopt(engine)
+
+    def _adopt(self, engine):
+        self.core.bootstrap(engine)
+
+
+def restore_from_peer_bytes(data):
+    # free functions adopting peer bytes are just as dangerous
+    return load_snapshot(data)  # MARK: unverified-snapshot-adopt

@@ -1,0 +1,44 @@
+"""Fixture: unverified-snapshot-adopt negative cases — snapshot
+adoption that DOES reach the proof helpers (directly or through a
+self-call), plus the local-disk restore shapes the rule must leave
+alone (load_checkpoint is trusted local state, not peer bytes)."""
+
+from babble_tpu.store.checkpoint import load_checkpoint, load_snapshot
+from babble_tpu.store.proof import (
+    verify_snapshot_digest,
+    verify_snapshot_proof,
+)
+
+
+class VerifyingNode:
+    def __init__(self, core, conf):
+        self.core = core
+        self.conf = conf
+
+    async def catch_up(self, peer_pub, snap_hash, resp):
+        if not verify_snapshot_proof(
+            peer_pub, snap_hash, resp.lcr, resp.position, resp.digest,
+            resp.sig_r, resp.sig_s,
+        ):
+            raise ValueError("forged snapshot")
+        engine = load_snapshot(resp.snapshot)
+        err = verify_snapshot_digest(engine, resp.digest, resp.position)
+        if err is not None:
+            raise ValueError(err)
+        self.core.bootstrap(engine)
+
+    async def catch_up_via_helper(self, resp):
+        # verification reached through the self-call closure
+        engine = load_snapshot(resp.snapshot)
+        self._verify_ff_digest(engine, resp)
+        self.core.bootstrap(engine)
+
+    def _verify_ff_digest(self, engine, resp):
+        err = verify_snapshot_digest(engine, resp.digest, resp.position)
+        if err is not None:
+            raise ValueError(err)
+
+    def resume_local(self, path):
+        # local checkpoint restore: our own durable state, no peer in
+        # the loop — out of the rule's scope
+        return load_checkpoint(path)

@@ -68,6 +68,21 @@ _MINI_DISKROT = {
 }
 
 
+#: silent-peer survival (ISSUE 8) in miniature: a mid-life crash, a
+#: long silence (many decided rounds past inactive_rounds), and a
+#: rejoin — eviction must advance past the dead creator (bounded
+#: memory + recorded horizon) and the return must bootstrap through
+#: verified fast-forward + post-horizon chain continuation
+_MINI_DEAD_CREATOR = {
+    "name": "mini-dead-creator", "nodes": 4, "steps": 260, "seed": 5,
+    "cache_size": 64, "seq_window": 8, "inactive_rounds": 6,
+    "txs": 8, "tx_every": 8, "settle_rounds": 4, "liveness_bound": 55,
+    "invariants": ["prefix_agreement", "liveness", "fast_forwarded",
+                   "eviction_advanced"],
+    "plan": {"crashes": [{"node": 3, "crash": 30, "restart": 200}]},
+}
+
+
 def test_fixed_seed_is_bit_for_bit_reproducible():
     """Identical fault schedule and identical committed order across
     two runs of the same (scenario, seed) — the fingerprint covers the
@@ -141,6 +156,26 @@ def test_honest_crash_restart_recovers_through_the_wal():
     assert not any(r.fork_detected.values()), r.fork_detected
     # the restarted node made post-restart progress
     assert r.consensus_counts_final[2] > 0
+
+
+def test_dead_creator_eviction_advances_and_rejoin_fast_forwards():
+    """The ISSUE-8 tentpole in miniature: while node 3 is silent for
+    many decided rounds, the survivors' eviction horizon moves PAST it
+    (per-creator eviction: its tail evicts, memory stays bounded — the
+    pre-PR wedge grew the live window for the whole outage) and a
+    horizon is recorded; the rejoin is forced through verified
+    fast-forward and the fleet reaches prefix agreement across it."""
+    sc = Scenario.from_dict(_MINI_DEAD_CREATOR)
+    r = run_scenario(sc)
+    assert r.report.ok, r.report.format()
+    # the dead creator's tail was evicted and its horizon recorded
+    assert r.eviction_horizons.get(3, -1) >= 0
+    # memory stayed bounded through the outage
+    assert r.outage_live_window_max <= 8 * sc.cache_size
+    # the rejoin went through the (verified) snapshot path
+    assert r.fast_forwards[3] == 1
+    # nobody ever read the restart as an equivocation
+    assert not any(r.fork_detected.values()), r.fork_detected
 
 
 def test_disk_rot_recovers_and_is_reproducible():

@@ -1,0 +1,452 @@
+"""Silent-peer survival (ISSUE 8): per-creator eviction, eviction
+horizons, post-horizon chain continuation, signed fast-forward proofs,
+the ts32 rolling rebase, and the latency-window stall fallback.
+
+The tentpole's contract, unit-sized:
+
+- a creator that goes silent stops pinning eviction fleet-wide: its
+  retained tail evicts once it falls ``inactive_rounds`` decided rounds
+  behind, a per-creator horizon is recorded, and NONE of it changes a
+  single consensus decision (parity vs an unbounded engine);
+- the horizon (and the commit digest) round-trip through checkpoints;
+- a chain resumes PAST its eviction horizon through the continuation
+  insert rule, including compact-wire resolution of the evicted parent;
+- fast-forward snapshots carry signed state proofs: forged bytes,
+  forged frontiers and rewritten committed windows are all rejected,
+  honest ones verify.
+"""
+
+import numpy as np
+import pytest
+
+from babble_tpu.consensus.digest import CommitDigest, GENESIS_DIGEST, fold
+from babble_tpu.consensus.engine import TpuHashgraph
+from babble_tpu.core.event import Event, new_event
+from babble_tpu.crypto.keys import key_from_scalar
+from babble_tpu.sim import random_gossip_dag
+from babble_tpu.sim.generator import GeneratedDag, _fake_pub
+
+
+def silent_creator_dag(n, n_events, silent, silent_after, seed=0,
+                       base_ts=1_700_000_000_000_000_000,
+                       ts_step=1_000_000):
+    """random_gossip_dag's shape with one creator going SILENT: after
+    ``silent_after`` events, creator ``silent`` neither mints nor is
+    gossiped with — its chain head freezes while the rest of the fleet
+    keeps deciding rounds past it."""
+    rng = np.random.default_rng(seed)
+    participants = {("0x" + _fake_pub(i).hex().upper()): i
+                    for i in range(n)}
+    pubs = [_fake_pub(i) for i in range(n)]
+    events, heads, seqs = [], [None] * n, [0] * n
+
+    def sign_fake(ev):
+        ev.r = int(rng.integers(1, 1 << 62))
+        ev.s = int(rng.integers(1, 1 << 62))
+
+    for i in range(n):
+        ev = new_event([], ("", ""), pubs[i], 0, timestamp=base_ts)
+        sign_fake(ev)
+        events.append(ev)
+        heads[i] = ev.hex()
+        seqs[i] = 1
+    t = 0
+    went_silent = False
+    while len(events) < n_events:
+        t += 1
+        cut = len(events) >= silent_after
+        live = ([i for i in range(n) if i != silent] if cut
+                else list(range(n)))
+        receiver = int(rng.choice(live))
+        if cut and not went_silent:
+            # the mid-life-crash shape: the silent creator's head DID
+            # propagate before the outage (a survivor merges it), so
+            # its whole chain is eventually ordered and evictable
+            went_silent = True
+            sender = silent
+        else:
+            sender = int(rng.choice([i for i in live if i != receiver]))
+        ev = new_event(
+            [b"tx-%d" % t], (heads[receiver], heads[sender]),
+            pubs[receiver], seqs[receiver],
+            timestamp=base_ts + t * ts_step,
+        )
+        sign_fake(ev)
+        events.append(ev)
+        heads[receiver] = ev.hex()
+        seqs[receiver] += 1
+    return GeneratedDag(participants, events, n, seed)
+
+
+def _run_chunks(engine, events, chunk=16):
+    for i, ev in enumerate(events):
+        engine.insert_event(ev.clone())
+        if (i + 1) % chunk == 0:
+            engine.run_consensus()
+    engine.run_consensus()
+
+
+def _rolled(dag, **kw):
+    args = dict(
+        e_cap=256, s_cap=64, r_cap=64, verify_signatures=False,
+        auto_compact=True, seq_window=8, compact_min=16, round_margin=2,
+    )
+    args.update(kw)
+    return TpuHashgraph(dag.participants, **args)
+
+
+# ----------------------------------------------------------------------
+# per-creator eviction
+
+
+def test_silent_creator_no_longer_pins_eviction():
+    """The eviction-wedge fix itself: with inactive_rounds set, the
+    slot prefix advances PAST the silent creator's retained tail, its
+    window empties, and its eviction horizon is recorded — while the
+    pre-PR policy (inactive_rounds=None) provably wedges on the same
+    stream (the defect, kept as a negative control)."""
+    dag = silent_creator_dag(4, 500, silent=3, silent_after=60, seed=41)
+    sid = 3
+
+    wedged = _rolled(dag, inactive_rounds=None)
+    _run_chunks(wedged, dag.events)
+    w_chain = wedged.dag.chains[sid]
+    assert w_chain.window, "control: prefix eviction kept the tail"
+    # the wedge: nothing above the silent tail's first retained slot
+    # ever evicts, so the live window grows with the outage
+    assert wedged.dag.slot_base <= w_chain[w_chain.start]
+
+    fixed = _rolled(dag, inactive_rounds=4)
+    _run_chunks(fixed, dag.events)
+    f_chain = fixed.dag.chains[sid]
+    assert not f_chain.window, "silent creator's tail must evict"
+    assert len(f_chain) == f_chain.start
+    horizon = fixed.dag.evicted_heads[sid]
+    assert horizon[0] == len(f_chain) - 1
+    assert fixed._evicted_creators_cache == 1
+    assert fixed.stats_snapshot()["evicted_creators"] == 1
+    # memory: the fixed engine's live window is a fraction of the
+    # wedged one's
+    live_fixed = fixed.dag.n_events - fixed.dag.slot_base
+    live_wedged = wedged.dag.n_events - wedged.dag.slot_base
+    assert live_fixed < live_wedged // 2, (live_fixed, live_wedged)
+
+
+def test_per_creator_eviction_changes_no_decision():
+    """Safety: inactivity eviction frees memory, never consensus — the
+    committed order matches an unbounded engine bit-for-bit."""
+    dag = silent_creator_dag(4, 420, silent=3, silent_after=50, seed=42)
+    plain = TpuHashgraph(
+        dag.participants, e_cap=1024, s_cap=256, r_cap=64,
+        verify_signatures=False,
+    )
+    fixed = _rolled(dag, inactive_rounds=4)
+    _run_chunks(plain, dag.events)
+    _run_chunks(fixed, dag.events)
+    assert not fixed.dag.chains[3].window, "eviction never fired"
+    assert plain.consensus_events() == fixed.consensus_events()
+    assert plain.consensus_transactions == fixed.consensus_transactions
+    assert plain.commit_digest == fixed.commit_digest
+
+
+def test_horizon_and_digest_round_trip_checkpoint(tmp_path):
+    from babble_tpu.store import load_checkpoint, save_checkpoint
+
+    dag = silent_creator_dag(4, 400, silent=3, silent_after=50, seed=43)
+    engine = _rolled(dag, inactive_rounds=4)
+    _run_chunks(engine, dag.events)
+    assert engine.dag.evicted_heads, "no horizon to round-trip"
+
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(engine, path)
+    restored = load_checkpoint(path)
+    assert restored.dag.evicted_heads == engine.dag.evicted_heads
+    assert restored.inactive_rounds == engine.inactive_rounds
+    assert restored._evicted_creators_cache == 1
+    assert restored.commit_digest == engine.commit_digest
+    assert restored.commit_length == engine.commit_length
+    assert restored._digest.anchor == engine._digest.anchor
+    assert restored._digest.anchor_pos == engine._digest.anchor_pos
+    # the restored responder can still attest recent positions
+    pos = engine.commit_length - 1
+    assert restored.commit_digest_at(pos) == engine.commit_digest_at(pos)
+
+
+def test_snapshot_policy_honors_disabled_inactive_rounds():
+    """The override spells "disabled" as 0 (None is _pol's absent-key
+    sentinel): a node running with the inactivity policy off must not
+    silently adopt the peer snapshot's value on fast-forward."""
+    from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+    dag = random_gossip_dag(4, 120, seed=51)
+    engine = _rolled(dag, inactive_rounds=4)
+    _run_chunks(engine, dag.events)
+    snap = snapshot_bytes(engine)
+    off = load_snapshot(snap, verify_events=False,
+                        policy={"inactive_rounds": 0})
+    assert off.inactive_rounds is None
+    local = load_snapshot(snap, verify_events=False,
+                          policy={"inactive_rounds": 7})
+    assert local.inactive_rounds == 7
+    fallback = load_snapshot(snap, verify_events=False)
+    assert fallback.inactive_rounds == 4
+
+
+# ----------------------------------------------------------------------
+# post-horizon chain continuation
+
+
+def _evicted_engine(seed=44):
+    dag = silent_creator_dag(4, 400, silent=3, silent_after=50, seed=seed)
+    engine = _rolled(dag, inactive_rounds=4)
+    _run_chunks(engine, dag.events)
+    assert not engine.dag.chains[3].window
+    return dag, engine
+
+
+def test_continuation_insert_resumes_evicted_chain():
+    dag, engine = _evicted_engine()
+    sid = 3
+    idx, horizon_hex = engine.dag.evicted_heads[sid]
+    pub = _fake_pub(sid)
+    live_head = engine.dag.events[engine.dag.chains[0][-1]]
+    ev = new_event([b"resume"], (horizon_hex, live_head.hex()), pub,
+                   idx + 1, timestamp=1_800_000_000_000_000_000)
+    ev.r, ev.s = 7, 9
+    engine.insert_event(ev)
+    chain = engine.dag.chains[sid]
+    assert chain.window and chain[-1] == engine.dag.slot_of[ev.hex()]
+    # and the chain EXTENDS normally from there
+    ev2 = new_event([b"resume2"], (ev.hex(), live_head.hex()), pub,
+                    idx + 2, timestamp=1_800_000_000_000_000_001)
+    ev2.r, ev2.s = 7, 10
+    engine.insert_event(ev2)
+    # consensus still runs over the resumed chain
+    engine.run_consensus()
+
+    # compact wire round-trip: the continuation's self-parent resolves
+    # through the horizon record, not the (evicted) chain window
+    w = engine.to_wire(ev)
+    back = engine.read_wire_info(w)
+    assert back.hex() == ev.hex()
+
+
+def test_continuation_insert_rejects_forged_anchors():
+    from babble_tpu.core.dag import InsertError
+
+    dag, engine = _evicted_engine(seed=45)
+    sid = 3
+    idx, horizon_hex = engine.dag.evicted_heads[sid]
+    pub = _fake_pub(sid)
+    live_head = engine.dag.events[engine.dag.chains[0][-1]].hex()
+
+    # wrong self-parent hash: not the recorded horizon
+    ev = new_event([b"x"], ("ff" * 32, live_head), pub, idx + 1,
+                   timestamp=1)
+    ev.r = ev.s = 1
+    with pytest.raises(InsertError, match="self-parent not known"):
+        engine.insert_event(ev)
+    # wrong index: a gap past the horizon
+    ev = new_event([b"x"], (horizon_hex, live_head), pub, idx + 2,
+                   timestamp=1)
+    ev.r = ev.s = 1
+    with pytest.raises(InsertError):
+        engine.insert_event(ev)
+    # a creator whose window is NOT empty gets no continuation shortcut
+    live_cid = 0
+    lh = engine.dag.chains[live_cid]
+    assert lh.window
+    ev = new_event([b"x"], ("ee" * 32, live_head),
+                   _fake_pub(live_cid), len(lh), timestamp=1)
+    ev.r = ev.s = 1
+    with pytest.raises(InsertError, match="self-parent not known"):
+        engine.insert_event(ev)
+
+
+# ----------------------------------------------------------------------
+# commit digest + signed state proofs
+
+
+def test_commit_digest_primitives():
+    dg = CommitDigest()
+    assert dg.head == GENESIS_DIGEST and dg.digest_at(0) == GENESIS_DIGEST
+    entries = ["%02x" % i * 32 for i in range(6)]
+    for e in entries:
+        dg.note(e)
+    assert dg.head == fold(GENESIS_DIGEST, entries)
+    assert dg.digest_at(3) == fold(GENESIS_DIGEST, entries[:3])
+    assert dg.digest_at(99) is None
+    dg.evict_to(4)
+    assert dg.anchor_pos == 4
+    assert dg.anchor == fold(GENESIS_DIGEST, entries[:4])
+    assert fold(dg.anchor, entries[4:]) == dg.head
+    assert dg.digest_at(2) is None      # below the anchor: history gone
+    # round trip
+    dg2 = CommitDigest.from_meta(dg.to_meta())
+    assert (dg2.head, dg2.length, dg2.anchor, dg2.anchor_pos) == (
+        dg.head, dg.length, dg.anchor, dg.anchor_pos
+    )
+    CommitDigest.check_meta(dg.to_meta())
+    with pytest.raises(ValueError):
+        CommitDigest.check_meta({"len": -1, "head": "x", "anchor": None,
+                                 "anchor_pos": 0, "recent": []})
+
+
+def test_snapshot_proof_sign_verify_and_forgery():
+    from babble_tpu.store.proof import (
+        sign_attestation,
+        sign_snapshot_proof,
+        snapshot_hash,
+        verify_attestation,
+        verify_snapshot_proof,
+    )
+
+    key = key_from_scalar(1234567)
+    snap = b"snapshot-bytes"
+    h = snapshot_hash(snap)
+    digest = "ab" * 32
+    r, s = sign_snapshot_proof(key, h, 7, 42, digest)
+    assert verify_snapshot_proof(key.pub_hex, h, 7, 42, digest, r, s)
+    # any field bent breaks the binding
+    assert not verify_snapshot_proof(key.pub_hex, h, 8, 42, digest, r, s)
+    assert not verify_snapshot_proof(key.pub_hex, h, 7, 41, digest, r, s)
+    assert not verify_snapshot_proof(
+        key.pub_hex, snapshot_hash(b"other"), 7, 42, digest, r, s)
+    assert not verify_snapshot_proof(
+        key.pub_hex, h, 7, 42, "cd" * 32, r, s)
+    other = key_from_scalar(7654321)
+    assert not verify_snapshot_proof(other.pub_hex, h, 7, 42, digest, r, s)
+
+    r, s = sign_attestation(key, 42, digest)
+    assert verify_attestation(key.pub_hex, 42, digest, r, s)
+    assert not verify_attestation(key.pub_hex, 43, digest, r, s)
+    assert not verify_attestation(other.pub_hex, 42, digest, r, s)
+
+
+def test_rewritten_window_fails_digest_refold():
+    """verify_snapshot_digest catches a snapshot whose committed window
+    was permuted (even with the head digest left 'honest'), and accepts
+    the genuine article."""
+    from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+    from babble_tpu.store.proof import verify_snapshot_digest
+
+    dag = random_gossip_dag(4, 240, seed=46)
+    engine = _rolled(dag)
+    _run_chunks(engine, dag.events)
+    snap = snapshot_bytes(engine)
+    restored = load_snapshot(snap, verify_events=False)
+    assert verify_snapshot_digest(
+        restored, engine.commit_digest, engine.commit_length
+    ) is None
+
+    # forged frontier: proof names a different digest/length
+    assert verify_snapshot_digest(
+        restored, "ab" * 32, engine.commit_length
+    ) is not None
+    assert verify_snapshot_digest(
+        restored, engine.commit_digest, engine.commit_length + 1
+    ) is not None
+
+    # un-anchorable window: anchor=None must REJECT, not degrade — a
+    # forger could otherwise keep the honest head, drop the anchor,
+    # and permute the window past every local check
+    unanchored = load_snapshot(snap, verify_events=False)
+    unanchored._digest.anchor = None
+    err = verify_snapshot_digest(
+        unanchored, engine.commit_digest, engine.commit_length
+    )
+    assert err is not None and "anchor" in err
+
+    # rewritten history with the honest head digest kept: re-fold fails
+    win = restored.consensus.window
+    assert len(win) >= 2
+    win[0], win[1] = win[1], win[0]
+    err = verify_snapshot_digest(
+        restored, engine.commit_digest, engine.commit_length
+    )
+    assert err is not None and "rewritten" in err
+
+
+# ----------------------------------------------------------------------
+# ts32 rolling rebase (PR 7 leftover b)
+
+
+def test_ts32_rebase_survives_wallclock_span():
+    """With compaction on, the span guard tracks the LIVE window: a
+    timestamp stream whose TOTAL span overflows int32 ns passes as long
+    as the windowed span stays narrow — while a non-compacting ts32
+    engine on the same stream still trips the guard (the guard itself
+    must not rot)."""
+    # ~8.6e6 ns per event: 400 events span ~3.4e9 ns > 2^31
+    dag = silent_creator_dag(4, 400, silent=3, silent_after=10**9,
+                             seed=47, ts_step=8_600_000)
+    span = dag.events[-1].body.timestamp - dag.events[0].body.timestamp
+    assert span > (1 << 31)
+
+    rolled = _rolled(dag, ts32=True, inactive_rounds=None)
+    _run_chunks(rolled, dag.events)          # no OverflowError
+    assert rolled.dag.slot_base > 0
+
+    plain = TpuHashgraph(
+        dag.participants, e_cap=1024, s_cap=256, r_cap=64,
+        verify_signatures=False, ts32=True,
+    )
+    with pytest.raises(OverflowError, match="ts32"):
+        _run_chunks(plain, dag.events)
+
+    # and the rebased engine's decisions match an i64 reference
+    ref = TpuHashgraph(
+        dag.participants, e_cap=1024, s_cap=256, r_cap=64,
+        verify_signatures=False,
+    )
+    _run_chunks(ref, dag.events)
+    assert ref.consensus_events()[-50:] == \
+        rolled.consensus_events()[-50:]
+
+
+# ----------------------------------------------------------------------
+# latency-window stall fallback (PR 7 leftover d)
+
+
+def test_head_round_min_host_matches_device():
+    from babble_tpu.ops.state import head_round_min_math
+
+    for seed in (48, 49):
+        dag = silent_creator_dag(4, 300, silent=3, silent_after=40,
+                                 seed=seed)
+        engine = _rolled(dag, inactive_rounds=4, finality_gate=True)
+        _run_chunks(engine, dag.events)
+        dev = int(head_round_min_math(engine.cfg, engine.state))
+        assert dev == engine._head_round_min_host()
+
+
+def test_stalled_gate_stays_on_latency_kernel():
+    """All peers down: the lone live chain piles up levels without
+    advancing rounds.  Pre-PR the span estimate pushed every flush onto
+    the throughput surface; now the window is bounded at the staleness
+    horizon, the flush stays on the latency kernel, and the occurrences
+    count on flush_fallbacks."""
+    dag = random_gossip_dag(4, 120, seed=50)
+    engine = _rolled(dag, finality_gate=True, kernel_class="auto")
+    _run_chunks(engine, dag.events)
+
+    # outage: only creator 0 keeps minting (self-parent chain)
+    pub0 = _fake_pub(0)
+    head = engine.dag.events[engine.dag.chains[0][-1]]
+    fb0 = engine.flush_fallbacks
+    seq = head.index
+    sp = head.hex()
+    ts = head.body.timestamp
+    for burst in range(3):
+        for i in range(40):
+            seq += 1
+            ts += 1_000
+            ev = new_event([b"solo"], (sp, sp), pub0, seq, timestamp=ts)
+            ev.r, ev.s = 3, 5 + seq
+            engine.insert_event(ev)
+            sp = ev.hex()
+        engine.run_consensus()
+        assert engine.last_kernel_class == "latency", (
+            "stalled-gate flush degraded to the throughput surface"
+        )
+    assert engine.flush_fallbacks > fb0

@@ -286,6 +286,38 @@ def test_wal_gossip_rule_passes_the_real_core():
             and f.path == core_path] == []
 
 
+def test_snapshot_adopt_fixture_findings():
+    """A path that builds an engine from peer-supplied snapshot bytes
+    without reaching the signed-state-proof helpers in its call
+    closure is flagged (the ISSUE-8 verified-fast-forward discipline);
+    verified adoption — direct or through a self-call helper — and
+    local-disk checkpoint restores stay clean."""
+    path = _fixture("snapshot_adopt_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(
+        findings, "unverified-snapshot-adopt"
+    ) == _marked_lines(path, "unverified-snapshot-adopt"), \
+        [f.format() for f in findings]
+    assert len(findings) == 3, [f.format() for f in findings]
+
+    ok = check_file(_fixture("snapshot_adopt_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_snapshot_adopt_rule_passes_the_real_node():
+    """node/node.py is where the rule earns its keep: _fast_forward
+    calls load_snapshot and must reach the proof helpers through its
+    closure (_verify_ff_responder / _verify_ff_quorum /
+    verify_snapshot_digest) — clean with zero suppressions."""
+    node_path = os.path.join(PKG, "node", "node.py")
+    findings = run_paths([PKG], ALL_RULES, known_rules=RULE_NAMES,
+                         include_suppressed=True)
+    assert [f for f in findings
+            if f.rule == "unverified-snapshot-adopt"
+            and f.path == node_path] == []
+
+
 def test_stale_suppression_fixture_findings():
     """A suppression whose rule no longer fires on its line is itself a
     finding, anchored at the comment; a live suppression is not."""
