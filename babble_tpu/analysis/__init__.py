@@ -60,6 +60,7 @@ from .tracer import (
     JitTracedBranchRule,
     JitUnhashableStaticRule,
 )
+from .quorummath import StaleQuorumMathRule
 from .snapshotadopt import UnverifiedSnapshotAdoptRule
 from .walgossip import WalBeforeGossipRule
 
@@ -77,6 +78,7 @@ ALL_RULES = [
     FalsyOrFallbackRule(),
     WalBeforeGossipRule(),
     UnverifiedSnapshotAdoptRule(),
+    StaleQuorumMathRule(),
 ]
 
 RULE_NAMES = ({r.name for r in ALL_RULES}
@@ -107,6 +109,7 @@ __all__ = [
     "JitHostSyncRule",
     "JitTracedBranchRule",
     "JitUnhashableStaticRule",
+    "StaleQuorumMathRule",
     "UnverifiedSnapshotAdoptRule",
     "WalBeforeGossipRule",
 ]

@@ -59,9 +59,11 @@ def forge_snapshot_response(
         [msgpack.packb(meta, use_bin_type=True), npz_b], use_bin_type=True
     )
     r, s = sign_snapshot_proof(
-        key, snapshot_hash(snap), resp.lcr, resp.position, head
+        key, snapshot_hash(snap), resp.lcr, resp.position, head,
+        resp.epoch,
     )
     return FastForwardResponse(
         from_addr=resp.from_addr, snapshot=snap, lcr=resp.lcr,
         position=resp.position, digest=head, sig_r=r, sig_s=s,
+        epoch=resp.epoch,
     )

@@ -29,6 +29,9 @@ FAULT_KINDS = (
     "forged_snapshot",
     "checkpoint_corrupt", "checkpoint_truncate", "wal_corrupt",
     "wal_truncate",
+    # membership churn + adversarial time (runner-applied; recorded so
+    # the schedule fingerprint covers them)
+    "join", "leave", "clock_skew",
 )
 
 
@@ -108,6 +111,23 @@ class FaultInjector:
                 f"babble-chaos:{self.seed}:disk:{node}"
             )
         return rng
+
+    def clock_drift_ns(self, node: int) -> int:
+        """Per-node bounded clock drift (membership/ROADMAP-5 chaos):
+        one constant offset per node per run, uniform in ±max_ms, from
+        a dedicated seeded stream — so enabling skew never shifts any
+        other fault stream's draws.  0 when the plan drifts no clocks
+        or this node is excluded."""
+        skew = self.plan.clock_skew
+        if skew is None or not skew.affects(node) or skew.max_ms <= 0:
+            return 0
+        key = ("skew", node)
+        rng = self._node_rngs.get(key)
+        if rng is None:
+            rng = self._node_rngs[key] = random.Random(
+                f"babble-chaos:{self.seed}:skew:{node}"
+            )
+        return int(rng.uniform(-skew.max_ms, skew.max_ms) * 1e6)
 
     # ------------------------------------------------------------------
     # decisions

@@ -139,7 +139,13 @@ class InvariantChecker:
         # explicitly supports restart=None) — agreement is checked over
         # the nodes that finished the run
         present = [i for i in result.honest if i in result.committed]
-        honest = [i for i in present if i not in result.restarted]
+        # restarted nodes AND mid-run joiners (membership plane) enter
+        # the stream mid-way: their logs are checked as contiguous
+        # slices instead of strict prefixes
+        midstream = set(result.restarted) | set(
+            getattr(result, "joined", ())
+        )
+        honest = [i for i in present if i not in midstream]
         logs = {i: result.committed[i] for i in present}
         if honest:
             ref = max(honest, key=lambda i: len(logs[i]))
@@ -157,14 +163,14 @@ class InvariantChecker:
                         f"nodes {i} and {ref} diverge at commit #{k}: "
                         f"{logs[i][k:k + 1]} vs {logs[ref][k:k + 1]}",
                     ))
-            for i in sorted(result.restarted):
+            for i in sorted(midstream):
                 if i not in result.honest or i not in logs:
                     continue
                 if not _is_contiguous_sublist(logs[i], logs[ref]):
                     report.violations.append(Violation(
                         "prefix_agreement",
-                        f"restarted node {i}'s committed log is not a "
-                        f"contiguous slice of node {ref}'s "
+                        f"restarted/joined node {i}'s committed log is "
+                        f"not a contiguous slice of node {ref}'s "
                         f"({len(logs[i])} vs {len(logs[ref])} commits)",
                     ))
         # consensus event order must agree too (stronger than tx order:
@@ -286,6 +292,106 @@ class InvariantChecker:
                 "forgery was silently installed or the joiner never "
                 "met the forger",
             ))
+
+    def _check_epoch_agreement(self, scenario, result, report) -> None:
+        """Membership plane: every honest node applied every scheduled
+        transition, at the same decided-round boundary, yielding the
+        same epoch — the ledger is consensus state, so any divergence
+        here is a safety bug."""
+        expected = len(scenario.plan.joins) + len(scenario.plan.leaves)
+        if expected == 0:
+            report.violations.append(Violation(
+                "epoch_agreement",
+                "scenario declares the epoch_agreement invariant but "
+                "schedules no membership transitions",
+            ))
+            return
+        logs = {
+            i: tuple(result.membership_logs.get(i, ()))
+            for i in result.honest if i in result.alive
+        }
+        for i, log in sorted(logs.items()):
+            if len(log) != expected:
+                report.violations.append(Violation(
+                    "epoch_agreement",
+                    f"node {i} applied {len(log)} of {expected} "
+                    f"scheduled membership transitions",
+                ))
+        distinct = {log for log in logs.values()}
+        if len(distinct) > 1:
+            report.violations.append(Violation(
+                "epoch_agreement",
+                "honest nodes disagree on the membership ledger "
+                f"({len(distinct)} distinct (epoch, kind, pub, "
+                "boundary) sequences)",
+            ))
+        epochs = {result.epochs.get(i) for i in logs}
+        if len(epochs) > 1:
+            report.violations.append(Violation(
+                "epoch_agreement",
+                f"honest nodes ended at different epochs: {epochs}",
+            ))
+
+    def _check_skew_robust_order(self, scenario, result, report) -> None:
+        """Adversarial time: bounded clock drift must never REORDER two
+        commits that the drift-free twin run orders strictly by
+        (round_received, consensus_ts).  (rr, cts)-TIED commits fall to
+        the whitened-signature tiebreak — deterministic across the
+        fleet within each run, but legitimately different between the
+        two runs, because the drifted timestamps live inside the signed
+        event bodies.  So the claim checked is exactly the ISSUE's:
+        median-timestamp ORDER is unaffected by ±drift within bound."""
+        if scenario.plan.clock_skew is None:
+            report.violations.append(Violation(
+                "skew_robust_order",
+                "scenario declares the skew_robust_order invariant but "
+                "drifts no clocks",
+            ))
+            return
+        twin = result.noskew_committed
+        keys_all = result.noskew_keys
+        if twin is None or keys_all is None:
+            report.violations.append(Violation(
+                "skew_robust_order",
+                "drift-free twin run missing (runner did not attach "
+                "noskew_committed/noskew_keys)",
+            ))
+            return
+        for i in sorted(result.honest):
+            if i not in result.alive:
+                continue
+            a = result.committed.get(i)
+            b = twin.get(i)
+            keys = keys_all.get(i, {})
+            if a is None or b is None:
+                continue
+            if set(a) != set(b):
+                report.violations.append(Violation(
+                    "skew_robust_order",
+                    f"node {i}: drift changed WHICH transactions "
+                    f"committed ({len(a)} vs {len(b)})",
+                ))
+                continue
+            pos_a = {tx: j for j, tx in enumerate(a)}
+            bad = None
+            for j in range(len(b)):
+                for k in range(j + 1, len(b)):
+                    x, y = b[j], b[k]
+                    kx, ky = keys.get(x), keys.get(y)
+                    if kx is None or ky is None or kx == ky:
+                        continue   # tie (or key rolled off): may permute
+                    if pos_a[x] > pos_a[y]:
+                        bad = (x, y, kx, ky)
+                        break
+                if bad:
+                    break
+            if bad:
+                report.violations.append(Violation(
+                    "skew_robust_order",
+                    f"node {i}: ±{scenario.plan.clock_skew.max_ms} ms "
+                    f"drift reordered two strictly-(rr, cts)-ordered "
+                    f"commits ({bad[2]} vs {bad[3]})",
+                ))
 
     def _check_fast_forwarded(self, scenario, result, report) -> None:
         restarted = sorted(result.restarted)

@@ -28,6 +28,10 @@ KNOWN_INVARIANTS = (
     "fast_forwarded",     # a restarted node caught up via snapshot
     "eviction_advanced",  # a silent creator's tail evicted; memory bounded
     "ff_proof_rejected",  # a forged snapshot was refused (proof quorum)
+    "epoch_agreement",    # every honest node applied every membership
+                          # transition at the same decided round
+    "skew_robust_order",  # committed order identical to the same run
+                          # with clock drift off (cts median robustness)
 )
 
 BYZANTINE_MODES = ("fork", "stale_replay", "forge_snapshot")
@@ -150,6 +154,51 @@ class Crash:
             )
 
 
+@dataclass(frozen=True)
+class MembershipOp:
+    """One scheduled churn verb (membership plane).  ``join``: node
+    ``node`` (an index at or past the founding set — the runner boots
+    it as an observer at this tick) submits its signed join tx through
+    node ``via``'s pool.  ``leave``: founding-or-joined node ``node``
+    announces departure — the tx is signed by the SUBJECT's key but may
+    be submitted via any live node, which is what makes
+    leave-mid-outage possible (the runner holds every scenario key)."""
+
+    kind: str            # "join" | "leave"
+    tick: int
+    node: int
+    via: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in ("join", "leave"):
+            raise ValueError(f"unknown membership kind {self.kind!r}")
+        if self.tick < 0:
+            raise ValueError("membership tick must be >= 0")
+
+
+@dataclass(frozen=True)
+class ClockSkew:
+    """Per-node bounded clock drift (ROADMAP item 5, first slice):
+    every affected node's ``Core.now_ns`` is offset by a constant drawn
+    from the injector's seeded per-node stream, uniform in
+    ``[-max_ms, +max_ms]``.  ``nodes=None`` drifts everyone.  The
+    ``skew_robust_order`` invariant asserts the committed order is
+    IDENTICAL to the drift-free twin run — median timestamps absorb
+    bounded per-creator skew."""
+
+    max_ms: float = 0.5
+    nodes: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.max_ms < 0:
+            raise ValueError("clock skew max_ms must be >= 0")
+        if self.nodes is not None:
+            object.__setattr__(self, "nodes", tuple(self.nodes))
+
+    def affects(self, node: int) -> bool:
+        return self.nodes is None or node in self.nodes
+
+
 #: disk-fault kinds, in the order the injector draws them at restart
 DISK_FAULT_KINDS = (
     "checkpoint_corrupt", "checkpoint_truncate",
@@ -224,6 +273,12 @@ class FaultPlan:
     byzantine: Optional[ByzantineSpec] = None
     #: durable-state rot applied at restart time (None = disks behave)
     disk: Optional[DiskFaults] = None
+    #: membership churn verbs (membership plane): scheduled join/leave
+    #: transitions submitted as signed txs through the ordinary ingress
+    joins: List[MembershipOp] = field(default_factory=list)
+    leaves: List[MembershipOp] = field(default_factory=list)
+    #: per-node bounded clock drift (None = clocks honest)
+    clock_skew: Optional[ClockSkew] = None
 
     def link(self, src: int, dst: int) -> LinkFaults:
         """Resolved faults for the directed link src -> dst (last
@@ -238,24 +293,49 @@ class FaultPlan:
     def partitioned(self, src: int, dst: int, tick: float) -> bool:
         return any(p.separates(src, dst, tick) for p in self.partitions)
 
-    def validate(self, n_nodes: int) -> None:
-        def _node(i, what):
-            if not 0 <= i < n_nodes:
-                raise ValueError(f"{what} node {i} out of range 0..{n_nodes - 1}")
+    def validate(self, n_nodes: int, joiners: int = 0) -> None:
+        total = n_nodes + joiners
+
+        def _node(i, what, bound=n_nodes):
+            if not 0 <= i < bound:
+                raise ValueError(
+                    f"{what} node {i} out of range 0..{bound - 1}"
+                )
 
         for ov in self.overrides:
             for v, what in ((ov.src, "override src"), (ov.dst, "override dst")):
                 if v is not None:
-                    _node(v, what)
+                    _node(v, what, total)
         for p in self.partitions:
             for i in p.group:
-                _node(i, "partition")
-            if len(p.group) >= n_nodes:
+                _node(i, "partition", total)
+            if len(p.group) >= total:
                 raise ValueError("partition group must leave someone outside")
         for c in self.crashes:
-            _node(c.node, "crash")
+            _node(c.node, "crash", total)
         if self.byzantine is not None:
             _node(self.byzantine.node, "byzantine")
+        if len(self.joins) != joiners:
+            raise ValueError(
+                f"plan schedules {len(self.joins)} joins but the "
+                f"scenario declares {joiners} joiners"
+            )
+        for j, op in enumerate(self.joins):
+            if op.kind != "join":
+                raise ValueError("joins list carries a non-join op")
+            if op.node != n_nodes + j:
+                raise ValueError(
+                    f"join #{j} must target node {n_nodes + j} (joiner "
+                    f"indices follow the founding set in schedule order)"
+                )
+            if op.via is not None:
+                _node(op.via, "join via")
+        for op in self.leaves:
+            if op.kind != "leave":
+                raise ValueError("leaves list carries a non-leave op")
+            _node(op.node, "leave", total)
+            if op.via is not None:
+                _node(op.via, "leave via", total)
 
     def to_dict(self) -> dict:
         out: dict = {"default": self.default.to_dict()}
@@ -280,12 +360,28 @@ class FaultPlan:
                                 "at": b.at, "prob": b.prob}
         if self.disk is not None:
             out["disk"] = self.disk.to_dict()
+        if self.joins:
+            out["joins"] = [
+                {"tick": op.tick, "node": op.node, "via": op.via}
+                for op in self.joins
+            ]
+        if self.leaves:
+            out["leaves"] = [
+                {"tick": op.tick, "node": op.node, "via": op.via}
+                for op in self.leaves
+            ]
+        if self.clock_skew is not None:
+            out["clock_skew"] = {
+                "max_ms": self.clock_skew.max_ms,
+                "nodes": (list(self.clock_skew.nodes)
+                          if self.clock_skew.nodes is not None else None),
+            }
         return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultPlan":
         known = {"default", "overrides", "partitions", "crashes",
-                 "byzantine", "disk"}
+                 "byzantine", "disk", "joins", "leaves", "clock_skew"}
         extra = set(d) - known
         if extra:
             raise ValueError(f"unknown fault plan keys: {sorted(extra)}")
@@ -298,6 +394,7 @@ class FaultPlan:
             ))
         byz = d.get("byzantine")
         disk = d.get("disk")
+        skew = d.get("clock_skew")
         return cls(
             default=LinkFaults.from_dict(d.get("default", {})),
             overrides=overrides,
@@ -305,6 +402,11 @@ class FaultPlan:
             crashes=[Crash(**c) for c in d.get("crashes", [])],
             byzantine=ByzantineSpec(**byz) if byz else None,
             disk=DiskFaults.from_dict(disk) if disk else None,
+            joins=[MembershipOp(kind="join", **j)
+                   for j in d.get("joins", [])],
+            leaves=[MembershipOp(kind="leave", **lv)
+                    for lv in d.get("leaves", [])],
+            clock_skew=ClockSkew(**skew) if skew else None,
         )
 
 
@@ -317,6 +419,10 @@ class Scenario:
     nodes: int = 4
     steps: int = 240
     seed: int = 7
+    #: membership plane: nodes beyond the founding set that JOIN during
+    #: the run (plan.joins schedules when; joiner i takes scenario index
+    #: nodes + i).  Joiners boot as observers at their join tick.
+    joiners: int = 0
     plan: FaultPlan = field(default_factory=FaultPlan)
     #: consensus engine the cluster runs: "fused" (honest) or
     #: "byzantine" (fork-aware).  A fork-attack scenario run with
@@ -360,13 +466,21 @@ class Scenario:
                 f"unknown invariants {sorted(unknown)}; "
                 f"known: {KNOWN_INVARIANTS}"
             )
+        if self.joiners < 0:
+            raise ValueError("joiners must be >= 0")
+        if self.joiners and self.engine != "fused":
+            raise ValueError(
+                "membership churn requires the fused engine (epoch "
+                "transitions are not implemented for wide/byzantine)"
+            )
         object.__setattr__(self, "invariants", tuple(self.invariants))
-        self.plan.validate(self.nodes)
+        self.plan.validate(self.nodes, self.joiners)
 
     def to_dict(self) -> dict:
         return {
             "name": self.name, "nodes": self.nodes, "steps": self.steps,
-            "seed": self.seed, "engine": self.engine,
+            "seed": self.seed, "joiners": self.joiners,
+            "engine": self.engine,
             "cache_size": self.cache_size, "seq_window": self.seq_window,
             "inactive_rounds": self.inactive_rounds,
             "txs": self.txs, "tx_every": self.tx_every,
@@ -383,9 +497,9 @@ class Scenario:
         d = dict(d)
         plan = FaultPlan.from_dict(d.pop("plan", {}))
         known = {
-            "name", "nodes", "steps", "seed", "engine", "cache_size",
-            "seq_window", "inactive_rounds", "txs", "tx_every",
-            "invariants", "liveness_bound", "settle_rounds",
+            "name", "nodes", "steps", "seed", "joiners", "engine",
+            "cache_size", "seq_window", "inactive_rounds", "txs",
+            "tx_every", "invariants", "liveness_bound", "settle_rounds",
             "checkpoint_every", "tick_seconds",
         }
         extra = set(d) - known

@@ -61,6 +61,18 @@ def deterministic_keys(seed: int, n: int) -> List[KeyPair]:
     return sorted(keys, key=lambda k: k.pub_hex)
 
 
+def joiner_keys(seed: int, n: int) -> List[KeyPair]:
+    """Keypairs for mid-run joiners (membership plane) — a SEPARATE
+    derivation stream, unsorted: joiner ids are assigned by consensus
+    (append order at each epoch boundary), not by pub-hex rank."""
+    keys = []
+    for i in range(n):
+        digest = sha256(f"babble-chaos-joiner:{seed}:{i}".encode())
+        d = int.from_bytes(digest, "big") % (P256_ORDER - 1) + 1
+        keys.append(key_from_scalar(d))
+    return keys
+
+
 @dataclass
 class ScenarioResult:
     """Everything a scenario run observed, in JSON-able form."""
@@ -93,6 +105,25 @@ class ScenarioResult:
     #: fast-forward snapshots each node refused on proof failure
     #: (babble_ff_proof_rejects_total at run end)
     ff_proof_rejects: Dict[int, int] = field(default_factory=dict)
+    #: membership plane: per-node final epoch and membership ledger
+    #: ((epoch, kind, pub, boundary) tuples — the epoch_agreement
+    #: invariant requires them identical on every honest node)
+    epochs: Dict[int, int] = field(default_factory=dict)
+    membership_logs: Dict[int, list] = field(default_factory=dict)
+    #: scenario indices that joined mid-run (prefix agreement treats
+    #: them like restarts: their log starts mid-stream)
+    joined: Set[int] = field(default_factory=set)
+    #: committed logs of the drift-free twin run (skew_robust_order)
+    noskew_committed: Optional[Dict[int, List[str]]] = None
+    #: per-node committed tx -> (round_received, consensus_ts) keys of
+    #: the drift-free twin — the strict-order baseline drift must not
+    #: permute ((rr, cts)-TIED commits fall to the whitened-signature
+    #: tiebreak, which legitimately differs between runs because the
+    #: drifted timestamps are inside the signed bodies)
+    noskew_keys: Optional[Dict[int, dict]] = None
+    #: this run's own committed-key map (kept so a run can serve as a
+    #: twin)
+    committed_keys: Dict[int, dict] = field(default_factory=dict)
     report: Optional[InvariantReport] = None
 
     def fingerprint(self) -> str:
@@ -136,6 +167,12 @@ class ScenarioResult:
             "ff_proof_rejects": {
                 str(k): v for k, v in sorted(self.ff_proof_rejects.items())
             },
+            "epochs": {str(k): v for k, v in sorted(self.epochs.items())},
+            "membership_logs": {
+                str(k): [list(t) for t in v]
+                for k, v in sorted(self.membership_logs.items())
+            },
+            "joined": sorted(self.joined),
             "invariants": self.report.to_dict() if self.report else None,
         }
 
@@ -157,7 +194,11 @@ class ScenarioRunner:
     """Deterministic in-memory execution of one scenario."""
 
     def __init__(self, scenario: Scenario, seed: Optional[int] = None,
-                 consensus_every: int = 6, kernel_class: str = "auto"):
+                 consensus_every: int = 6, kernel_class: str = "auto",
+                 _twin: bool = False):
+        #: this run IS a drift-free twin (skew_robust_order): collect
+        #: committed keys, never recurse into another twin
+        self._twin = _twin
         self.scenario = scenario
         #: compiled-surface pin for the fused engine (node/config.py):
         #: the incremental-vs-full parity suite runs the same scenario
@@ -168,7 +209,61 @@ class ScenarioRunner:
         self.consensus_every = consensus_every
 
     def run(self) -> ScenarioResult:
-        return asyncio.run(self._run())
+        result = asyncio.run(self._run())
+        sc = self.scenario
+        if (sc.plan.clock_skew is not None
+                and "skew_robust_order" in sc.invariants):
+            # the invariant is a differential claim: the same (scenario,
+            # seed) with drift OFF must commit the identical order —
+            # median timestamps absorb bounded per-creator skew.  Run
+            # the drift-free twin and re-check.
+            d = sc.to_dict()
+            d["plan"].pop("clock_skew", None)
+            d["invariants"] = [
+                i for i in d["invariants"] if i != "skew_robust_order"
+            ]
+            from .plan import Scenario as _Scenario
+
+            twin = ScenarioRunner(
+                _Scenario.from_dict(d), seed=self.seed,
+                consensus_every=self.consensus_every,
+                kernel_class=self.kernel_class, _twin=True,
+            ).run()
+            result.noskew_committed = dict(twin.committed)
+            result.noskew_keys = dict(twin.committed_keys)
+            result.report = InvariantChecker().check(sc, result)
+        return result
+
+    async def _membership_op(self, op, handles, boot, injector,
+                             result, n_founders: int) -> None:
+        """Execute one scheduled churn verb: boot the joiner (observer)
+        and submit its signed join tx, or submit a leave tx signed by
+        the departing key — both through an ordinary live node's pool,
+        because membership transitions ARE transactions.  The subject's
+        key signs either way (the runner holds every scenario key, so
+        leave-mid-outage works even while the leaver is down)."""
+        from ..membership.transition import build_membership_tx
+
+        h = handles[op.node]
+        if op.kind == "join" and h.node is None:
+            boot(h)
+            result.joined.add(op.node)
+        via = None
+        if op.via is not None and handles[op.via].alive:
+            via = handles[op.via]
+        if via is None:
+            via = next(
+                (x for x in handles
+                 if x.alive and x.idx != op.node and x.idx < n_founders),
+                None,
+            )
+        if via is None:
+            return   # nobody alive to carry the transition
+        epoch = int(getattr(via.node.core.hg, "epoch", 0))
+        tx = build_membership_tx(op.kind, h.key, h.addr, epoch)
+        async with via.node.core_lock:
+            via.node.transaction_pool.append(tx)
+        injector.record(op.kind, op.node, via.idx, epoch=epoch)
 
     # ------------------------------------------------------------------
 
@@ -187,8 +282,12 @@ class ScenarioRunner:
             tick_ns["t"] += 1_000_000
             return tick_ns["t"]
 
-        keys = deterministic_keys(seed, n)
-        addrs = [f"inmem://chaos{i}" for i in range(n)]
+        # membership plane: founders get canonical ids (sorted keys);
+        # joiner identities come from a separate stream and take the
+        # scenario indices past the founding set
+        total = n + sc.joiners
+        keys = deterministic_keys(seed, n) + joiner_keys(seed, sc.joiners)
+        addrs = [f"inmem://chaos{i}" for i in range(total)]
         addr_index = {a: i for i, a in enumerate(addrs)}
         peers = [
             Peer(net_addr=addrs[i], pub_key_hex=keys[i].pub_hex)
@@ -196,7 +295,9 @@ class ScenarioRunner:
         ]
         net = InmemNetwork()
         handles = [
-            _Handle(idx=i, addr=addrs[i], key=keys[i]) for i in range(n)
+            _Handle(idx=i, addr=addrs[i], key=keys[i],
+                    alive=(i < n))
+            for i in range(total)
         ]
 
         # Honest crash scenarios run DURABLY: each node writes a real
@@ -246,9 +347,27 @@ class ScenarioRunner:
                            else None),
             )
             h.proxy = InmemAppProxy()
-            h.node = Node(make_conf(h.idx), h.key, peers, transport,
+            conf = make_conf(h.idx)
+            node_peers = peers
+            if h.idx >= n:
+                # joiner: the founders are its consensus bootstrap set;
+                # its own address rides only the address book (it is an
+                # observer until its join tx's epoch boundary)
+                conf.bootstrap_peers = list(peers)
+                node_peers = peers + [
+                    Peer(net_addr=h.addr, pub_key_hex=h.key.pub_hex)
+                ]
+            h.node = Node(conf, h.key, node_peers, transport,
                           h.proxy, engine=engine)
-            h.node.core.now_ns = clock
+            # adversarial time (ROADMAP 5 first slice): a per-node
+            # bounded drift offset from the injector's seeded stream
+            # rides on the shared logical clock through the Core.now_ns
+            # hook — event bodies stay deterministic per (seed, node)
+            drift = injector.clock_drift_ns(h.idx)
+            if drift:
+                h.node.core.now_ns = (lambda d=drift: clock() + d)
+            else:
+                h.node.core.now_ns = clock
             if engine is None:
                 # recovery-aware: skipped when WAL replay restored a
                 # head, deferred while the seq probe negotiates
@@ -256,14 +375,25 @@ class ScenarioRunner:
             h.node.run_task(gossip=False)
             h.alive = True
 
-        for h in handles:
+        for h in handles[:n]:
             boot(h)
+        if sc.plan.clock_skew is not None:
+            for h in handles[:n]:
+                d = injector.clock_drift_ns(h.idx)
+                if d:
+                    injector.record("clock_skew", h.idx, h.idx,
+                                    drift_ns=d)
 
         byz = sc.plan.byzantine
         honest = [i for i in range(n) if byz is None or byz.node != i]
         result = ScenarioResult(name=sc.name, seed=seed, steps=sc.steps,
                                 honest=honest)
+        honest.extend(range(n, total))   # joiners are never byzantine
         sched = crash_schedule(sc.plan)
+        #: membership churn schedule: tick -> ops (declaration order)
+        member_sched: Dict[int, List] = {}
+        for op in list(sc.plan.joins) + list(sc.plan.leaves):
+            member_sched.setdefault(op.tick, []).append(op)
         heal_ticks = [p.heal for p in sc.plan.partitions
                       if p.heal is not None]
         heal_ticks += [c.restart for c in sc.plan.crashes
@@ -345,6 +475,10 @@ class ScenarioRunner:
                     for h in handles:
                         if h.alive:
                             await h.node.save_checkpoint(ckpt_dir(h.idx))
+                for op in member_sched.get(step, ()):
+                    await self._membership_op(
+                        op, handles, boot, injector, result, n
+                    )
                 if heal_tick is not None and step == heal_tick:
                     result.consensus_counts_at_heal = await sample_counts()
                 if (heal_tick is not None
@@ -379,6 +513,14 @@ class ScenarioRunner:
                         fork_done = True
 
                 live_idx = [h.idx for h in handles if h.alive]
+                # the dialable universe: founders plus joiners that have
+                # BOOTED (a joiner's address exists only from its join
+                # tick on).  Identical to range(n) for churn-free
+                # scenarios, so their draws — and fingerprints — are
+                # untouched.
+                uni = n + sum(
+                    1 for h in handles[n:] if h.node is not None
+                )
                 if (forced_gossip and handles[forced_gossip[0][0]].alive
                         and handles[forced_gossip[0][1]].alive):
                     a, b = forced_gossip.pop(0)
@@ -389,7 +531,7 @@ class ScenarioRunner:
                     # — a real peer selector dials from peers.json with
                     # no liveness oracle, so the fleet keeps paying the
                     # dial-a-dead-peer failure exactly like production
-                    b = rng.choice([i for i in range(n) if i != a])
+                    b = rng.choice([i for i in range(uni) if i != a])
                     await gossip_once(a, b)
 
                 # silent-peer observations (eviction_advanced): while
@@ -425,10 +567,10 @@ class ScenarioRunner:
             injector.advance_to(sc.steps)
             injector.quiesce = True
             for _ in range(sc.settle_rounds):
-                for a in range(n):
+                for a in range(total):
                     if not handles[a].alive:
                         continue
-                    for b in range(n):
+                    for b in range(total):
                         if b != a and handles[b].alive:
                             await gossip_once(a, b)
                 await self._consensus_pass(handles)
@@ -450,6 +592,23 @@ class ScenarioRunner:
                 result.consensus[h.idx] = list(
                     h.node.core.hg.consensus_events()
                 )
+                if sc.plan.clock_skew is not None or self._twin:
+                    # committed (rr, cts) keys for skew_robust_order:
+                    # read from the retained window (these scenarios
+                    # never evict it)
+                    dag = h.node.core.hg.dag
+                    keys: Dict[str, tuple] = {}
+                    for hx in h.node.core.hg.consensus_events():
+                        slot = dag.slot_of.get(hx)
+                        if slot is None:
+                            continue
+                        ev = dag.events[slot]
+                        for tx in ev.transactions:
+                            keys[tx.hex()] = (
+                                ev.round_received,
+                                ev.consensus_timestamp,
+                            )
+                    result.committed_keys[h.idx] = keys
                 snap = h.node.core.hg.stats_snapshot()
                 result.fork_detected[h.idx] = (
                     snap.get("forked_creators", 0) > 0
@@ -463,6 +622,15 @@ class ScenarioRunner:
                 swapped = (h.restarted
                            and h.node.core.hg is not h.engine_at_restart)
                 result.fast_forwards[h.idx] = 1 if swapped else 0
+                # membership plane: the epoch ledger every honest node
+                # must agree on (epoch_agreement invariant)
+                result.epochs[h.idx] = int(
+                    getattr(h.node.core.hg, "epoch", 0)
+                )
+                result.membership_logs[h.idx] = [
+                    (e["epoch"], e["kind"], e["pub"], e["boundary"])
+                    for e in getattr(h.node.core.hg, "membership_log", ())
+                ]
         finally:
             for h in handles:
                 if h.alive:

@@ -161,6 +161,76 @@ CANNED: Dict[str, dict] = {
             "byzantine": {"node": 1, "mode": "forge_snapshot", "at": 0},
         },
     },
+    # membership plane (ISSUE 9): a 4-node fleet GROWS to 5 and
+    # SHRINKS back to 4 under live client load.  The joiner boots as
+    # an observer at tick 60, its signed join tx is ordered like any
+    # transaction, every node applies the transition at the same
+    # decided-round boundary (epoch_agreement), the engine re-shapes
+    # [*,4,4] -> [*,5,5] and the joiner mints from the boundary on;
+    # at tick 230 founder 3 announces its leave and the quorum math
+    # tightens to the 4-member active set — with prefix agreement
+    # intact across BOTH epochs and every submitted tx committing
+    "join-under-load": {
+        "name": "join-under-load",
+        "nodes": 4, "steps": 400, "seed": 41, "joiners": 1,
+        "txs": 24, "tx_every": 6,
+        "invariants": ["prefix_agreement", "liveness", "all_committed",
+                       "epoch_agreement"],
+        "plan": {
+            "default": {"drop": 0.05},
+            "joins": [{"tick": 60, "node": 4, "via": 0}],
+            "leaves": [{"tick": 230, "node": 3, "via": 0}],
+        },
+    },
+    # a validator announces its leave while ANOTHER node is down: the
+    # transition must still order, apply at the same boundary on every
+    # live node (epoch_agreement), tighten the quorum math to the
+    # 3-member active set, and keep committing once the crashed node
+    # returns and catches up across the epoch boundary
+    "leave-mid-outage": {
+        "name": "leave-mid-outage",
+        "nodes": 4, "steps": 420, "seed": 43,
+        "txs": 16, "tx_every": 10, "liveness_bound": 140,
+        "invariants": ["prefix_agreement", "liveness",
+                       "epoch_agreement"],
+        "plan": {
+            "crashes": [{"node": 2, "crash": 80, "restart": 200}],
+            "leaves": [{"tick": 100, "node": 3, "via": 0}],
+        },
+    },
+    # a join is ordered while a founder sits on the wrong side of a
+    # partition: the cut node must apply the SAME boundary from the
+    # replayed history after healing (the straggler round-rescan path —
+    # old-epoch rounds keep old-epoch thresholds via the per-round sm
+    # array), and the whole 5-node fleet converges on one ledger
+    "join-under-partition": {
+        "name": "join-under-partition",
+        "nodes": 4, "steps": 400, "seed": 47, "joiners": 1,
+        "txs": 16, "tx_every": 10, "liveness_bound": 140,
+        "invariants": ["prefix_agreement", "liveness",
+                       "epoch_agreement"],
+        "plan": {
+            "default": {"drop": 0.03},
+            "partitions": [{"group": [3], "start": 50, "heal": 170}],
+            "joins": [{"tick": 60, "node": 4, "via": 0}],
+        },
+    },
+    # adversarial time (ROADMAP item 5, first slice): every node's
+    # claimed-timestamp clock drifts by a bounded per-node offset from
+    # the injector's seeded stream.  The committed order must be
+    # IDENTICAL to the drift-free twin run (skew_robust_order): median
+    # consensus timestamps absorb bounded per-creator skew
+    "clock-skew": {
+        "name": "clock-skew",
+        "nodes": 4, "steps": 240, "seed": 53,
+        "txs": 16, "tx_every": 8,
+        "invariants": ["prefix_agreement", "liveness", "all_committed",
+                       "skew_robust_order"],
+        "plan": {
+            "default": {"drop": 0.05},
+            "clock_skew": {"max_ms": 0.4},
+        },
+    },
     # a stale-sync replayer answers a sampled fraction of inbound syncs
     # with cached old state; dedup-by-hash must shrug it off
     "stale-replay": {

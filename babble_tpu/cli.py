@@ -84,6 +84,15 @@ async def _run_node(args) -> int:
 
     key = PemKeyFile(args.datadir).read()
     peers = JSONPeers(args.datadir).peers()
+    # membership plane: a JOINER's epoch-0 validator set is the
+    # founders' peers.json, while its own address rides only the
+    # gossip book — it observes until its signed join tx commits
+    bootstrap_peers = None
+    bp_path = getattr(args, "bootstrap_peers", "")
+    if bp_path:
+        from .net.peers import peers_from_file
+
+        bootstrap_peers = peers_from_file(bp_path)
 
     engine = None
     ckpt_dir = getattr(args, "checkpoint_dir", "")
@@ -172,6 +181,7 @@ async def _run_node(args) -> int:
                   if getattr(args, "inactive_rounds", -1) > 0 else 32)
         ),
         ff_verify=not getattr(args, "no_ff_verify", False),
+        bootstrap_peers=bootstrap_peers,
         byzantine=args.byzantine,
         fork_k=args.fork_k,
         fork_caps=_parse_fork_caps(getattr(args, "fork_caps", "")),
@@ -215,6 +225,7 @@ async def _run_node(args) -> int:
             timeout=conf.tcp_timeout,
             submit_per_client=getattr(args, "submit_per_client", 1024),
             submit_total=getattr(args, "submit_total", 8192),
+            submit_adaptive=getattr(args, "submit_adaptive", False),
         )
         await proxy.start()
 
@@ -671,6 +682,15 @@ def main(argv=None) -> int:
                     help="admission control: per-client submit queue cap")
     rn.add_argument("--submit_total", type=int, default=8192,
                     help="admission control: total submit queue cap")
+    rn.add_argument("--submit_adaptive", action="store_true",
+                    help="derive admission caps from the observed "
+                         "commit drain rate (EWMA) instead of the "
+                         "static caps")
+    rn.add_argument("--bootstrap_peers", default="",
+                    help="membership: path to the FOUNDING peers.json "
+                         "when this node is a joiner (its own address "
+                         "is only in the datadir peers.json; it "
+                         "observes until its signed join tx commits)")
     rn.add_argument("--consensus_interval", type=int, default=0,
                     help="ms between consensus pipeline runs (0 = every sync)")
     rn.add_argument("--byzantine", action="store_true",

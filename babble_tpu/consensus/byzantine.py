@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..core.event import Event, middle_bit
 from .ordering import consensus_sort
+from ..membership.quorum import supermajority
 
 
 class ByzantineInsertError(ValueError):
@@ -63,7 +64,7 @@ class ForkOracle:
 
     @property
     def super_majority(self) -> int:
-        return 2 * self.n // 3 + 1
+        return supermajority(self.n)
 
     # ------------------------------------------------------------------
 

@@ -57,6 +57,14 @@ _FD_FULL_THRESHOLD = 2048  # batch size above which full FD recompute wins
 #: ingest/catch-up ships thousands)
 LATENCY_K_MAX = 256
 
+#: membership plane: a committed transition takes effect at decided
+#: round ``round_received(tx) + EPOCH_LAG``.  Any positive lag works —
+#: reception requires ancestry of the deciding round's famous
+#: witnesses, so everything received at or below the boundary is held
+#: by every node that reaches it — but a small cushion keeps the
+#: boundary comfortably above the committing flush's own lcr jumps.
+EPOCH_LAG = 2
+
 _bucket = bucket
 
 
@@ -76,6 +84,14 @@ class TpuHashgraph:
     flush_fallbacks = 0
     inactive_rounds: Optional[int] = None
     _evicted_creators_cache = 0
+    # membership plane (ISSUE 9) class-level defaults: engines without
+    # epoch-transition support (wide/fork override nothing — committed
+    # membership txs are inert data there) still expose the epoch
+    # surface checkpoints/snapshots/metrics read
+    epoch = 0
+    pending_membership: Optional[dict] = None
+    membership_log: tuple = ()
+    membership_rejects = 0
 
     def __init__(
         self,
@@ -165,6 +181,18 @@ class TpuHashgraph:
         self.flush_fallbacks = 0
         self._fallback_counted = False   # per-flush dedup for the gauge
 
+        # Membership plane (ISSUE 9): the validator set is consensus
+        # state.  A committed, subject-signed transition tx schedules a
+        # transition at decided-round boundary rr + EPOCH_LAG; commits
+        # past the boundary are HELD until the engine re-shapes
+        # (apply_epoch_transition) and re-decides them under the new
+        # peer set.  membership_log is the chain of custody a joiner's
+        # fast-forward verifies (membership/epoch.py).
+        self.epoch = 0
+        self.pending_membership: Optional[dict] = None
+        self.membership_log: List[dict] = []
+        self.membership_rejects = 0
+
         self.consensus = OffsetList()             # hex ids in consensus order
         #: rolling hash chain over the committed order — the attestable
         #: frontier signed fast-forward proofs are built on (digest.py)
@@ -215,6 +243,9 @@ class TpuHashgraph:
             # creators whose retained tail was evicted for inactivity
             # (their return must bootstrap through verified fast-forward)
             "evicted_creators": self._evicted_creators_cache,
+            # membership plane: current epoch + transitions applied
+            "epoch": self.epoch,
+            "membership_transitions": len(self.membership_log),
         }
 
     # ------------------------------------------------------------------
@@ -295,21 +326,9 @@ class TpuHashgraph:
                 rnd[:ne] >= self._r_off + old_r_cap
             )[0].astype(np.int32)
             if len(sus):
-                lev = np.array(
-                    [self.dag.levels[base + int(s)] for s in sus], np.int64
-                )
-                order = np.argsort(lev, kind="stable")
-                ulev, starts = np.unique(lev[order], return_index=True)
-                bounds = list(starts) + [len(sus)]
-                t = len(ulev)
-                b = max(int(np.max(np.diff(bounds))), 1)
-                tpad, bpad = _bucket(t, 1), _bucket(b, 1)
-                slot_sched = np.full((tpad, bpad), -1, np.int32)
-                for row in range(t):
-                    grp = sus[order[bounds[row] : bounds[row + 1]]]
-                    slot_sched[row, : len(grp)] = grp
                 self.state = ingest_ops.rescan_rounds(
-                    self.cfg, self.state, jnp.asarray(slot_sched)
+                    self.cfg, self.state,
+                    jnp.asarray(self._level_sched(sus)),
                 )
                 self._view = {}
             self._max_round_cache = int(self.state.max_round)
@@ -421,7 +440,15 @@ class TpuHashgraph:
     def _collect_ordered(self) -> List[Event]:
         """Host half of the order phase, shared by the throughput and
         latency kernels: read rr/cts, commit newly-received events in
-        consensus_sort order, roll the window."""
+        consensus_sort order, roll the window.
+
+        Membership commit gate: while a peer-set transition is pending
+        at boundary B, events received in rounds > B are HELD — not
+        committed, not marked received — because their reception was
+        decided under the outgoing peer set and will be re-decided
+        (identically on every replica) after the epoch applies.
+        Everything at or below B commits under the old set on every
+        node; once lcr reaches B the transition applies in place."""
         rr = self._arr("rr")
         cts = self._arr("cts")
         base = self.dag.slot_base
@@ -432,26 +459,35 @@ class TpuHashgraph:
             if rr[s] >= 0 and (base + s) not in self._received
         ]
         if not new_slots:
+            self._maybe_apply_membership()
             if self.auto_compact:
                 self.maybe_compact()
             return []
 
-        new_events: List[Event] = []
+        candidates: List[Event] = []
         for s in new_slots:
             ev = self.dag.events[base + s]
             ev.round_received = int(rr[s])
             ev.consensus_timestamp = int(cts[s])
-            new_events.append(ev)
-            self._received.add(base + s)
-        self._ordered_total += len(new_slots)
+            candidates.append(ev)
 
         from .ordering import consensus_sort
 
-        new_events = consensus_sort(new_events, self._round_prn)
-        for ev in new_events:
+        candidates = consensus_sort(candidates, self._round_prn)
+        new_events: List[Event] = []
+        for ev in candidates:
+            pend = self.pending_membership
+            if pend is not None and ev.round_received > pend["boundary"]:
+                # held: re-received and committed by the next epoch
+                continue
+            new_events.append(ev)
+            self._received.add(self.dag.slot_of[ev.hex()])
             self.consensus.append(ev.hex())
             self._digest.note(ev.hex())
             self.consensus_transactions += len(ev.transactions)
+            if self.pending_membership is None:
+                self._maybe_schedule_membership(ev)
+        self._ordered_total += len(new_events)
 
         lcr = int(self.state.lcr)
         self._lcr_cache = lcr
@@ -463,6 +499,7 @@ class TpuHashgraph:
 
         if self.commit_callback is not None and new_events:
             self.commit_callback(new_events)
+        self._maybe_apply_membership()
         if self.auto_compact:
             self.maybe_compact()
         return new_events
@@ -644,6 +681,152 @@ class TpuHashgraph:
         return int(INT32_MAX) if out is None else out
 
     # ------------------------------------------------------------------
+    # membership plane (ISSUE 9): validator join/leave as a consensus op
+
+    def _maybe_schedule_membership(self, ev: Event) -> None:
+        """Scan one just-committed event for a valid membership
+        transition tx; the FIRST valid one schedules the transition at
+        boundary rr + EPOCH_LAG.  Runs on the commit path, so every
+        check is deterministic: later transitions that commit while one
+        is pending are dropped identically everywhere (resubmit)."""
+        from ..membership.transition import (
+            MEMBERSHIP_MAGIC, parse_membership_tx,
+        )
+
+        for tx in ev.transactions:
+            if not tx.startswith(MEMBERSHIP_MAGIC):
+                continue
+            spec = parse_membership_tx(tx)
+            err = self._validate_membership(spec)
+            if err is not None:
+                self.membership_rejects += 1
+                continue
+            self.pending_membership = {
+                "kind": spec.kind,
+                "pub": spec.pub_hex,
+                "addr": spec.net_addr,
+                "boundary": ev.round_received + EPOCH_LAG,
+                "position": len(self.consensus),
+                "tx": bytes(tx),
+            }
+            return
+
+    def _validate_membership(self, spec) -> Optional[str]:
+        """Deterministic admissibility of a parsed transition against
+        the CURRENT epoch's state (commit-time: every honest node
+        evaluates the same tx at the same epoch)."""
+        if spec is None:
+            return "unparseable transition"
+        if spec.epoch != self.epoch:
+            return (
+                f"transition stamped epoch {spec.epoch}, "
+                f"current epoch {self.epoch}"
+            )
+        if spec.kind == "join":
+            if spec.pub_hex in self.participants:
+                return "join for an existing participant"
+        else:
+            cid = self.participants.get(spec.pub_hex)
+            if cid is None:
+                return "leave for an unknown participant"
+            if cid in self.cfg.retired:
+                return "leave for an already-retired participant"
+            if self.cfg.active_n - 1 < 2:
+                return "leave would drop the fleet below 2 members"
+        if not spec.verify():
+            return "bad subject signature"
+        return None
+
+    def _maybe_apply_membership(self) -> None:
+        if self.pending_membership is None:
+            return
+        if int(self.state.lcr) >= self.pending_membership["boundary"]:
+            self.apply_epoch_transition()
+
+    def apply_epoch_transition(self) -> None:
+        """Re-shape the engine at the epoch boundary: every event
+        received in rounds <= B is committed (apply requires lcr >= B),
+        so the device state splits cleanly — decided history below B is
+        frozen under the outgoing peer set, everything above is reset
+        and re-decided under the incoming one.
+
+        Join grows the participant axis by one appended column (ids of
+        survivors are stable); leave retires the column in the config
+        (removing it would renumber every creator).  Either way the
+        DagConfig changes, so the compiled-program universe re-keys —
+        the AOT manifest records the new epoch's shapes like any other
+        config, which is what keeps a churned fleet's restarts warm."""
+        from ..ops.epoch import epoch_transition_arrays
+
+        spec = self.pending_membership
+        boundary = spec["boundary"]
+        old_cfg = self.cfg
+
+        # suspects must be read BEFORE the reset wipes their rounds
+        base = self.dag.slot_base
+        ne = self.dag.n_events - base
+        rnd = self._arr("round")
+        suspects = np.nonzero(rnd[:ne] > boundary)[0].astype(np.int32)
+
+        if spec["kind"] == "join":
+            cid = self.dag.add_participant(spec["pub"])
+            new_cfg = old_cfg._replace(n=old_cfg.n + 1)
+        else:
+            cid = self.participants[spec["pub"]]
+            new_cfg = old_cfg._replace(
+                retired=old_cfg.retired + (cid,)
+            )
+
+        arrays = epoch_transition_arrays(
+            old_cfg, new_cfg, self.state, boundary
+        )
+        self.cfg = new_cfg
+        self.state = DagState(
+            **{k: jnp.asarray(v) for k, v in arrays.items()}
+        )
+        self._view = {}
+        self._aot = {}   # executables were compiled for the old config
+        if len(suspects):
+            self.state = ingest_ops.rescan_rounds(
+                self.cfg, self.state, jnp.asarray(self._level_sched(suspects))
+            )
+            self._view = {}
+        self._max_round_cache = int(self.state.max_round)
+        self._lcr_cache = int(self.state.lcr)
+        self.epoch += 1
+        self.membership_log.append({
+            "epoch": self.epoch,
+            "kind": spec["kind"],
+            "pub": spec["pub"],
+            "addr": spec["addr"],
+            "boundary": boundary,
+            "position": spec["position"],
+            "cid": cid,
+            "tx": spec["tx"],
+        })
+        self.pending_membership = None
+
+    def _level_sched(self, sus: np.ndarray) -> np.ndarray:
+        """Level-grouped rescan schedule for local slots ``sus`` (the
+        shape rescan_rounds consumes; shared by round repair and epoch
+        transitions)."""
+        base = self.dag.slot_base
+        lev = np.array(
+            [self.dag.levels[base + int(s)] for s in sus], np.int64
+        )
+        order = np.argsort(lev, kind="stable")
+        ulev, starts = np.unique(lev[order], return_index=True)
+        bounds = list(starts) + [len(sus)]
+        t = len(ulev)
+        b = max(int(np.max(np.diff(bounds))), 1)
+        tpad, bpad = _bucket(t, 1), _bucket(b, 1)
+        slot_sched = np.full((tpad, bpad), -1, np.int32)
+        for row in range(t):
+            grp = sus[order[bounds[row]: bounds[row + 1]]]
+            slot_sched[row, : len(grp)] = grp
+        return slot_sched
+
+    # ------------------------------------------------------------------
     # rolling-window compaction (reference caches.go:45-76 applied to the
     # dense device state; see ops/state.py compact_impl)
 
@@ -672,8 +855,11 @@ class TpuHashgraph:
         (verified fast-forward + the continuation insert rule).
 
         Returns the number of evicted slots.  No-ops while host events are
-        pending (their parents must stay resolvable until flushed)."""
-        if self.dag.pending:
+        pending (their parents must stay resolvable until flushed) and
+        while a membership transition is pending (held commits — rr
+        decided above the boundary but deliberately not received — must
+        not be mistaken for evictable prefix)."""
+        if self.dag.pending or self.pending_membership is not None:
             return 0
         lcr = int(self.state.lcr)
         new_r_off = lcr - self.round_margin

@@ -85,6 +85,10 @@ class ForkHashgraph:
         # (initial_caps) collapses the demand-driven growth sequence to
         # one compiled shape at boot (Config.fork_caps rationale).
         self._caps = tuple(initial_caps) if initial_caps else (0, 0, 0)
+        #: AOT manifest directory (ops/aot.prewarm_engine): when set,
+        #: every pipeline capacity shape this engine compiles is
+        #: recorded so the next boot can pre-size + warm up front
+        self._aot_dir = None
 
     def pre_size(self, caps: tuple) -> None:
         """Raise the monotone pipeline capacities to at least ``caps``
@@ -293,6 +297,13 @@ class ForkHashgraph:
             if int(np.asarray(out.max_round)) < cfg.r_cap - 1:
                 break
             r_cap *= 2      # saturated: recompute with headroom
+        if self._aot_dir is not None:
+            from ..ops import aot as aot_ops
+
+            aot_ops.record_fork_caps(
+                self._aot_dir, self.n, self.k, self._caps,
+                sched=tuple(batch.sched.shape),
+            )
         self._out = (cfg, out)
         self._dirty = False
         lcr_loc = int(np.asarray(out.lcr))

@@ -40,6 +40,7 @@ from ..core.event import Event, WireEvent, middle_bit
 from ..crypto.keys import pub_hex_to_bytes
 from ..store.inmem import RoundInfo, Store
 from .ordering import consensus_sort
+from ..membership.quorum import supermajority
 
 _INT_MAX = np.iinfo(np.int64).max
 
@@ -85,7 +86,7 @@ class OracleHashgraph:
         return len(self.participants)
 
     def super_majority(self) -> int:
-        return 2 * self.n // 3 + 1
+        return supermajority(self.n)
 
     # ------------------------------------------------------------------
     # reachability predicates (all O(1) via coordinate vectors)

@@ -83,6 +83,20 @@ class HostDag:
         """First non-evicted slot (== the device state's e_off)."""
         return self.events.start
 
+    def add_participant(self, pub_hex: str) -> int:
+        """Membership plane: admit a new creator at the next free
+        participant id (ids of existing creators are STABLE across a
+        join — renumbering would scramble every creator-indexed
+        coordinate column).  Called only at an epoch boundary
+        (engine.apply_epoch_transition); returns the new id."""
+        if pub_hex in self.participants:
+            raise ValueError(f"participant {pub_hex[:18]}… already known")
+        cid = len(self.participants)
+        self.participants[pub_hex] = cid
+        self.reverse_participants[cid] = pub_hex
+        self.chains.append(OffsetList())
+        return cid
+
     # ------------------------------------------------------------------
 
     def insert(self, event: Event) -> int:

@@ -1,0 +1,54 @@
+"""Membership plane: dynamic validator join/leave as a consensus op.
+
+The reference babble fixes its validator set at boot (``peers.json``
+read once in ``cmd/main.go``); production fleets churn.  This package
+makes the peer set itself consensus state:
+
+- :mod:`.quorum` — the epoch-aware quorum helpers every threshold in
+  the tree routes through (enforced by the ``stale-quorum-math``
+  babble-lint rule): with membership dynamic, any inlined ``2*n//3``
+  computed against a stale ``n`` is a silent safety bug.
+- :mod:`.transition` — signed peer-set transition transactions
+  (join/leave, carrying pubkey + net address, signed by the subject)
+  that ride the ordinary tx stream and are ordered by consensus
+  itself.
+- :mod:`.epoch` — the epoch ledger: verification of a membership log
+  (a chain of signed transitions from a trusted base peer set), the
+  piece that lets a fast-forward joiner adopt a snapshot whose peer
+  set EXTENDS its bootstrap set without widening snapshot trust to
+  membership (the PR-8 signed-state-proof machinery's consumer).
+
+Epoch semantics (consensus/engine.py): a committed transition takes
+effect at a **decided-round boundary** ``B = round_received(tx) +
+EPOCH_LAG``; every honest node commits exactly the events received in
+rounds <= B under the old peer set, then re-shapes its engine (join:
+grow the participant axis; leave: retire the column) and re-decides
+rounds > B under the new set.  Quorum math is therefore always
+computed against the epoch's peer set, never a stale ``n``.
+"""
+
+from .quorum import (
+    attestation_quorum,
+    coin_period,
+    supermajority,
+    sync_quorum,
+)
+from .transition import (
+    MEMBERSHIP_MAGIC,
+    MembershipTx,
+    build_membership_tx,
+    parse_membership_tx,
+)
+from .epoch import verify_membership_chain
+
+__all__ = [
+    "attestation_quorum",
+    "coin_period",
+    "supermajority",
+    "sync_quorum",
+    "MEMBERSHIP_MAGIC",
+    "MembershipTx",
+    "build_membership_tx",
+    "parse_membership_tx",
+    "verify_membership_chain",
+]

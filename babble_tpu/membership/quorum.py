@@ -1,0 +1,46 @@
+"""Epoch-aware quorum arithmetic — the ONE place thresholds live.
+
+With dynamic membership (validator join/leave as a consensus op), any
+quorum expression inlined at a call site — ``2 * n // 3``,
+``n // 3 + 1``, ``len(self.peers) // 3`` — is a latent safety bug: the
+``n`` it closed over may belong to a previous epoch.  Every consensus /
+node / net path must route through these helpers with the *epoch's*
+active participant count, and the ``stale-quorum-math`` babble-lint
+rule (analysis/quorummath.py) flags any inlined form.
+
+Deliberately a leaf module (no imports beyond stdlib): ops/, node/ and
+analysis-time fixtures all import it, and it must load in environments
+without jax.
+"""
+
+from __future__ import annotations
+
+
+def supermajority(n: int) -> int:
+    """Witness/vote supermajority: more than two thirds of the active
+    set (reference hashgraph.go ``superMajority``).  Strongly-seeing
+    quorums, fame vote strength and round-increment thresholds all use
+    this."""
+    return 2 * n // 3 + 1
+
+
+def sync_quorum(n: int) -> int:
+    """Peer answers that, counting ourselves, form a supermajority —
+    the seq skip-ahead probe's completion threshold (node/core.py):
+    supermajority(n) members including us means this many PEERS."""
+    return 2 * n // 3
+
+
+def attestation_quorum(n: int) -> int:
+    """Matching signed commit digests required to adopt a fast-forward
+    snapshot (responder included): with fewer than a third of the
+    active set byzantine, any such set contains an honest signer, so a
+    rewritten history can never gather it (store/proof.py)."""
+    return n // 3 + 1
+
+
+def coin_period(n: int) -> int:
+    """Coin-round cadence of the fame vote recursion (reference
+    hashgraph.go:643): every n-th voting distance flips undecided
+    votes on the voter's middle hash bit."""
+    return max(n, 1)

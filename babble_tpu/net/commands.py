@@ -33,6 +33,23 @@ from ..core.event import FullWireEvent, WireEvent
 RPC_SYNC = 0
 
 
+def _sig_out(v: int) -> bytes:
+    """ECDSA scalars are 256-bit; msgpack ints cap at 64 bits.  The
+    event wire forms always shipped them as 32-byte blobs — the
+    proof-bearing commands (fast-forward / attestations) packed raw
+    ints, which only the serialization-free in-memory transport
+    tolerated; over TCP the encode raised OverflowError and the
+    catch-up silently degraded to a retry loop.  Encode as blobs,
+    accept both forms on unpack."""
+    return int(v).to_bytes(32, "big")
+
+
+def _sig_in(v) -> int:
+    if isinstance(v, (bytes, bytearray)):
+        return int.from_bytes(v, "big")
+    return int(v)
+
+
 def _unpack_events(events) -> List[WireEvent]:
     # 9 fields = compact WireEvent; 8 = byzantine-mode FullWireEvent
     return [
@@ -148,11 +165,16 @@ class FastForwardResponse:
     #: ECDSA signature over the proof message
     sig_r: int = 0
     sig_s: int = 0
+    #: responder's consensus epoch (membership plane) — bound into the
+    #: signed proof, so a snapshot cannot claim one epoch's peer set
+    #: under another epoch's digest
+    epoch: int = 0
 
     def pack(self) -> bytes:
         return msgpack.packb(
             [self.from_addr, self.snapshot, self.lcr, self.position,
-             self.digest, self.sig_r, self.sig_s],
+             self.digest, _sig_out(self.sig_r), _sig_out(self.sig_s),
+             self.epoch],
             use_bin_type=True,
         )
 
@@ -162,10 +184,15 @@ class FastForwardResponse:
         if len(fields) == 2:   # pre-proof peers
             from_addr, snapshot = fields
             return cls(from_addr=from_addr, snapshot=snapshot)
-        from_addr, snapshot, lcr, position, digest, r, s = fields
+        if len(fields) == 7:   # pre-epoch peers
+            from_addr, snapshot, lcr, position, digest, r, s = fields
+            epoch = 0
+        else:
+            (from_addr, snapshot, lcr, position, digest, r, s,
+             epoch) = fields
         return cls(from_addr=from_addr, snapshot=snapshot, lcr=int(lcr),
                    position=int(position), digest=digest,
-                   sig_r=int(r), sig_s=int(s))
+                   sig_r=_sig_in(r), sig_s=_sig_in(s), epoch=int(epoch))
 
     def approx_size(self) -> int:
         return 192 + len(self.snapshot)
@@ -184,15 +211,21 @@ class StateProofRequest:
 
     from_addr: str
     position: int
+    #: the snapshot's claimed epoch — attesters answer with their own,
+    #: and a mismatch at the same position is a reject (an attestation
+    #: from the wrong epoch cannot vouch for this peer set)
+    epoch: int = 0
 
     def pack(self) -> bytes:
-        return msgpack.packb([self.from_addr, self.position],
+        return msgpack.packb([self.from_addr, self.position, self.epoch],
                              use_bin_type=True)
 
     @classmethod
     def unpack(cls, data: bytes) -> "StateProofRequest":
-        from_addr, position = msgpack.unpackb(data, raw=False)
-        return cls(from_addr=from_addr, position=int(position))
+        fields = msgpack.unpackb(data, raw=False)
+        epoch = fields[2] if len(fields) > 2 else 0
+        return cls(from_addr=fields[0], position=int(fields[1]),
+                   epoch=int(epoch))
 
     def approx_size(self) -> int:
         return 64
@@ -210,19 +243,23 @@ class StateProofResponse:
     digest: str = ""
     sig_r: int = 0
     sig_s: int = 0
+    #: attester's consensus epoch, bound into the signature
+    epoch: int = 0
 
     def pack(self) -> bytes:
         return msgpack.packb(
             [self.from_addr, self.position, self.digest,
-             self.sig_r, self.sig_s],
+             _sig_out(self.sig_r), _sig_out(self.sig_s), self.epoch],
             use_bin_type=True,
         )
 
     @classmethod
     def unpack(cls, data: bytes) -> "StateProofResponse":
-        from_addr, position, digest, r, s = msgpack.unpackb(data, raw=False)
-        return cls(from_addr=from_addr, position=int(position),
-                   digest=digest, sig_r=int(r), sig_s=int(s))
+        fields = msgpack.unpackb(data, raw=False)
+        epoch = fields[5] if len(fields) > 5 else 0
+        return cls(from_addr=fields[0], position=int(fields[1]),
+                   digest=fields[2], sig_r=_sig_in(fields[3]),
+                   sig_s=_sig_in(fields[4]), epoch=int(epoch))
 
     def approx_size(self) -> int:
         return 192

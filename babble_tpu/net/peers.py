@@ -41,6 +41,17 @@ def exclude_peer(peers: List[Peer], addr: str) -> tuple[int, List[Peer]]:
     return idx, rest
 
 
+def peers_from_file(path: str) -> List[Peer]:
+    """Parse a peers.json-format file at an explicit path (the cli's
+    --bootstrap_peers loader shares JSONPeers' schema — one format to
+    evolve, not two)."""
+    with open(path) as f:
+        raw = json.load(f)
+    return [
+        Peer(net_addr=p["NetAddr"], pub_key_hex=p["PubKeyHex"]) for p in raw
+    ]
+
+
 class StaticPeers:
     """In-memory PeerStore (reference net/peer.go:44-66)."""
 

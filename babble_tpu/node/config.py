@@ -136,6 +136,14 @@ class Config:
     # None = n//3 + 1: any such set contains an honest signer while
     # fewer than a third of participants are byzantine.
     ff_proof_quorum: int | None = None
+    # ---- membership plane (ISSUE 9) ----
+    # Epoch-0 validator set when it differs from the gossip address
+    # book: a JOINER boots knowing the founding peers (its consensus
+    # bootstrap set) while its own address is only in `peers` — it runs
+    # as an observer until its signed join tx commits and the epoch
+    # boundary admits it.  None = the peers list IS the validator set
+    # (the static pre-membership behavior).
+    bootstrap_peers: list | None = None
     # Durability plane (babble_tpu/wal): "" disables the write-ahead
     # log (the pre-WAL behavior — restarts may re-mint published seqs
     # unless a fresh checkpoint exists).  With a directory set, every

@@ -15,6 +15,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..consensus.engine import TpuHashgraph
 from ..core.event import Event, WireEvent, new_event
 from ..crypto.keys import KeyPair
+from ..membership.quorum import sync_quorum
 from ..obs import Registry
 from ..wal import WriteAheadLog
 
@@ -76,6 +77,14 @@ class Core:
         self.key = key
         self.pub_hex = key.pub_hex
         self.participants = participants
+        # Membership plane: a node whose key is not (yet) in the epoch's
+        # peer set runs as an OBSERVER — it syncs, validates and commits
+        # like any replica but never mints, because no honest peer would
+        # accept an event from a non-member.  A committed join naming
+        # our key flips this (adopt_membership); a committed leave sets
+        # the retired flag the same way.
+        self._observer = key.pub_hex not in participants
+        self._retired_self = False
         self.registry = registry
         # event-timestamp clock, overridable for deterministic replay:
         # the chaos scenario runner installs a seeded logical clock here
@@ -84,11 +93,19 @@ class Core:
         self.now_ns: Callable[[], int] = time.time_ns
         if engine is not None:
             # an injected engine is authoritative: the mode flag must
-            # match its type, or diff()/head restore would misbehave
+            # match its type, or diff()/head restore would misbehave —
+            # and so is its PARTICIPANT set: a checkpoint restored from
+            # a later epoch legitimately differs from the boot peer
+            # list (membership plane), so the engine's epoch ledger
+            # wins and observer status is recomputed against it
             from ..consensus.fork_engine import ForkHashgraph
 
             self.hg = engine
             byzantine = isinstance(engine, ForkHashgraph)
+            participants = engine.participants
+            self.participants = participants
+            self._observer = self.pub_hex not in participants
+            self.id = participants.get(self.pub_hex, -1)
         elif byzantine:
             # fork-aware live mode: equivocations are accepted, detected
             # and discounted instead of rejected (ops/forks.py); gossip
@@ -198,15 +215,19 @@ class Core:
         self.last_bootstrap_lost_txs: List[bytes] = []
         # supermajority is 2n//3+1 members counting ourselves, so the
         # probe needs 2n//3 PEER answers — 0 for a single-participant
-        # fleet, where our own durable state is the only authority
-        self._probe_quorum = 2 * len(participants) // 3
+        # fleet, where our own durable state is the only authority.
+        # Routed through the epoch-aware helper: with dynamic
+        # membership this count must track the ACTIVE set.
+        self._probe_quorum = sync_quorum(self._active_count())
         if wal is not None:
             self._recover_from_wal()
         self.head: str = ""
         self.seq: int = -1
         # A resumed engine (store.load_checkpoint) already holds our chain —
-        # pick up where the checkpoint left off.
-        if byzantine:
+        # pick up where the checkpoint left off.  Observers have no chain.
+        if self._observer:
+            pass
+        elif byzantine:
             own = self.hg.dag.cr_events[participants[self.pub_hex]]
             if own:
                 head_ev = self.hg.dag.events[own[-1]]
@@ -322,11 +343,53 @@ class Core:
 
     def mint_blocked(self) -> bool:
         """True while creating a self-event could re-mint a published
-        sequence number: either the seq probe is still negotiating, or
-        the engine's view of our own chain sits below the recovery
-        ladder's mint floor (gossip / fast-forward will restore the
-        published tail, at which point minting resumes naturally)."""
+        sequence number — or while this node is not a member of the
+        current epoch's peer set at all (observer waiting on its join,
+        or retired by a committed leave): either the seq probe is still
+        negotiating, or the engine's view of our own chain sits below
+        the recovery ladder's mint floor (gossip / fast-forward will
+        restore the published tail, at which point minting resumes
+        naturally)."""
+        if self._observer or self._retired_self:
+            return True
         return self._probing or self.seq + 1 < self._min_next_seq
+
+    # ------------------------------------------------------------------
+    # membership plane (ISSUE 9)
+
+    def _active_count(self) -> int:
+        """Active members of the current epoch (retired columns
+        excluded) — the n every quorum is computed against."""
+        retired = getattr(getattr(self.hg, "cfg", None), "retired", ())
+        return len(self.participants) - len(retired)
+
+    def refresh_quorums(self) -> None:
+        """Re-derive every membership-dependent threshold after an
+        epoch transition (or an engine swap that carried one)."""
+        self._probe_quorum = sync_quorum(self._active_count())
+
+    def adopt_membership(self) -> None:
+        """A committed join named OUR key: we are a validator from the
+        epoch boundary on.  Idempotent (checkpoint-restored nodes
+        replay their ledger at boot)."""
+        cid = self.participants.get(self.pub_hex)
+        if cid is None:
+            return
+        self.id = cid
+        self._observer = False
+        chain = self.hg.dag.chains[cid]
+        if chain and chain.window:
+            head_ev = self.hg.dag.events[chain[-1]]
+            self.head = head_ev.hex()
+            self.seq = head_ev.index
+        self.refresh_quorums()
+
+    def retire_membership(self) -> None:
+        """A committed leave named OUR key: stop minting permanently
+        (the node keeps serving as an observer — its history remains
+        useful to the fleet until it shuts down)."""
+        self._retired_self = True
+        self.refresh_quorums()
 
     def probe_note(self, peer: str) -> bool:
         """One sync response from ``peer`` was applied while probing.
@@ -439,6 +502,31 @@ class Core:
             self._bootstrap_fork(engine)
             self._note_ff_adopted()
             return
+        # Membership plane: the adopted engine's epoch ledger is
+        # authoritative (validate_ff_snapshot verified its membership
+        # chain against our trusted set before we got here) — rebind
+        # our participant view and observer status to it.  A joiner
+        # bootstrapping through fast-forward becomes a member exactly
+        # when the snapshot's epoch says so.
+        self.participants = engine.participants
+        self._observer = self.pub_hex not in engine.participants
+        self.id = engine.participants.get(self.pub_hex, -1)
+        if self._observer:
+            # not (yet) a member: adopt the window wholesale; minting
+            # stays blocked until a later epoch admits us.  The WAL
+            # receipt/prune and the lost-tx reset still apply — stale
+            # records predating the adopted window would fail replay
+            # on the next restart, and a leftover lost-tx list from an
+            # earlier member-path bootstrap must not be re-pooled
+            self.hg = engine
+            self.head = ""
+            self.seq = -1
+            self.last_bootstrap_lost_txs = []
+            self.refresh_quorums()
+            self._apply_live_engine_policy()
+            self._rebind_engine_registry()
+            self._note_ff_adopted()
+            return
         cid = self.participants[self.pub_hex]
         chain = engine.dag.chains[cid]
         horizon = engine.dag.evicted_heads.get(cid)
@@ -514,6 +602,7 @@ class Core:
             self._probing = self._probe_quorum > 0
             self._probe_seen = set()
         self.last_bootstrap_lost_txs = lost_txs
+        self.refresh_quorums()
         self._apply_live_engine_policy()
         self._rebind_engine_registry()
         self._note_ff_adopted()
@@ -759,15 +848,36 @@ class Core:
         # convert the whole batch upfront (the elision scan needs every
         # hash before the first insert); the overlay resolves compact
         # parent references into the not-yet-inserted batch prefix with
-        # the same semantics the old convert-one-insert-one loop had
+        # the same semantics the old convert-one-insert-one loop had.
+        # Conversion is TOLERANT per event (membership plane): a peer
+        # one epoch ahead legitimately ships events of a creator we do
+        # not know yet, woven into the founders' chains as parents —
+        # those convert-fail (unknown creator id / unresolvable ref)
+        # and are SKIPPED, which recursively prunes everything built on
+        # them (children resolve parents through the overlay or local
+        # chains, both of which lack the skipped event).  What survives
+        # is exactly the old-epoch-reachable prefix — enough to reach
+        # the boundary, apply the transition, and accept the rest on
+        # the next exchange.  Without this, one cross-epoch sync wedged
+        # the laggard forever.
+        from ..common import TooLateError
+
         overlay: Dict[Tuple[int, int], str] = {}
         events: List[Event] = []
+        skipped = 0
         for w in wire_events:
-            ev = self.hg.read_wire_info(w, overlay)
+            try:
+                ev = self.hg.read_wire_info(w, overlay)
+            except (KeyError, IndexError, TooLateError) as e:
+                skipped += 1
+                self.last_insert_error = f"wire conversion skipped: {e}"
+                continue
             creator_cid = self.participants.get(ev.creator)
             if creator_cid is not None:
                 overlay[(creator_cid, ev.index)] = ev.hex()
             events.append(ev)
+        if skipped:
+            self.insert_failures += skipped
         _mark_chain_verified(events)
         for ev in events:
             if ev.hex() in self.hg.dag.slot_of:
@@ -821,6 +931,18 @@ class Core:
             # probe still negotiating).  Returning False tells the node
             # the payload never rode a self-event, so it requeues.
             return False
+        if other_head == "":
+            # headless responder: an observer (a joiner waiting on its
+            # epoch boundary) or a probe-blocked peer has no chain yet,
+            # so there is no merge parent to name — carry the payload
+            # on a self-parent event instead of minting an event with
+            # an empty other-parent (which every insert path rejects)
+            return self.add_self_event(payload)
+        if self.head == "":
+            # a freshly-admitted member's first mint (a joiner at its
+            # epoch boundary): the chain needs its root before a merge
+            # event can reference it
+            self.init()
         ev = new_event(
             payload, (self.head, other_head), self.key.pub_bytes,
             self.seq + 1, timestamp=self.now_ns(),

@@ -133,14 +133,35 @@ class Node:
         self.registry = registry if registry is not None else Registry()
         self.tracer = SpanTracer()
 
-        participants = canonical_ids(peers)
+        # Membership plane: the epoch-0 validator set may be a strict
+        # subset of the gossip address book — a joiner knows the
+        # founders (bootstrap_peers) but is not a member until its
+        # signed join tx commits and the boundary admits it.
+        member_peers = conf.bootstrap_peers or peers
+        participants = canonical_ids(member_peers)
+        if key.pub_hex not in participants and conf.bootstrap_peers is None:
+            # fail FAST on the static-deployment misconfiguration: a
+            # key missing from peers.json used to KeyError at boot, and
+            # silently degrading it to a permanent observer would run
+            # the fleet one validator short until someone noticed.
+            # Observer mode is only for DECLARED joiners
+            # (Config.bootstrap_peers set).
+            raise ValueError(
+                "this node's key is not in the peer set — add it to "
+                "peers.json, or declare the node a joiner via "
+                "Config.bootstrap_peers / --bootstrap_peers"
+            )
         self.participants = participants
         local_addr = transport.local_addr()
-        own_id = participants[key.pub_hex]
+        own_id = participants.get(key.pub_hex, -1)
         #: gossip address -> participant id (the push reconciliation
-        #: check needs to know which Known column is the peer's own)
+        #: check needs to know which Known column is the peer's own).
+        #: Address-book entries outside the epoch's validator set (a
+        #: joiner's own row before its join commits) have no column yet
+        #: — _sync_membership fills them at the boundary.
         self._addr_cid = {
-            p.net_addr: participants[p.pub_key_hex] for p in peers
+            p.net_addr: participants[p.pub_key_hex]
+            for p in peers if p.pub_key_hex in participants
         }
         #: gossip address -> participant pub hex (fast-forward proof
         #: verification resolves the responder's/attester's key by the
@@ -179,23 +200,26 @@ class Node:
         # restart reaches its first flush in seconds — and surface the
         # compile/cache counters on this node's /metrics
         if conf.aot_dir:
-            from ..consensus.engine import TpuHashgraph as _Fused
             from ..ops import aot as _aot
 
             _aot.bind_registry(self.registry)
-            # KERNEL_SPLIT excludes engines without the fused latency
-            # surface (WideHashgraph subclasses TpuHashgraph but owns
-            # its own blocked state — prewarming live_flush programs
-            # for it would be wasted compiles at best)
-            if (isinstance(self.core.hg, _Fused)
-                    and type(self.core.hg).KERNEL_SPLIT):
-                res = _aot.prewarm_engine(self.core.hg, conf.aot_dir)
-                self.logger.info(
-                    "AOT prewarm: %d programs compiled (%d from manifest)",
-                    res["compiled"], res["from_manifest"],
-                )
+            # every engine kind prewarms now (ROADMAP 3c leftover):
+            # fused replays its live-flush shape manifest, fork
+            # pre-sizes to the recorded pipeline capacities + warms,
+            # wide warms its fixed-shape march/fame/order programs —
+            # prewarm_engine dispatches internally
+            res = _aot.prewarm_engine(self.core.hg, conf.aot_dir)
+            self.logger.info(
+                "AOT prewarm: %d programs compiled (%d from manifest)",
+                res["compiled"], res["from_manifest"],
+            )
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
+        #: membership-log entries already reconciled into the node's
+        #: address maps / selector / metrics (index into engine log;
+        #: the log is consensus state, so the prefix is stable across
+        #: fast-forward engine swaps)
+        self._membership_seen = 0
         # heartbeat pacing draws from a per-identity seeded stream, not
         # the process-global RNG (found by the consensus-nondeterminism
         # taint pass): the jitter exists to desynchronize heartbeats
@@ -354,6 +378,24 @@ class Node:
             "round span (stalled-gate deferrals + throughput degrades)",
         ).set_function(
             lambda: getattr(self.core.hg, "flush_fallbacks", 0))
+        # membership plane: the epoch the engine is at, and transitions
+        # applied over this node's lifetime (both survive engine swaps
+        # — read through self.core.hg)
+        m.gauge(
+            "babble_epoch",
+            "consensus epoch (peer-set transitions applied since boot "
+            "of the fleet's history)",
+        ).set_function(lambda: getattr(self.core.hg, "epoch", 0))
+        self._m_transitions = m.counter(
+            "babble_membership_transitions_total",
+            "peer-set transitions (join/leave) this node applied at an "
+            "epoch boundary")
+        m.gauge(
+            "babble_membership_pending",
+            "1 while a committed transition awaits its epoch boundary",
+        ).set_function(
+            lambda: 1 if getattr(self.core.hg, "pending_membership", None)
+            else 0)
         self._loop_probe = LoopLagProbe(m)
         # transport-level series (bytes in/out, pool reuse) land on the
         # same /metrics page when the transport supports instrumentation
@@ -366,6 +408,9 @@ class Node:
         proxy_instrument = getattr(proxy, "instrument", None)
         if proxy_instrument is not None:
             proxy_instrument(m)
+        # a checkpoint-restored engine may carry epochs this node's
+        # boot peer list predates: reconcile the ledger now
+        self._sync_membership()
 
     # ------------------------------------------------------------------
     # registry-backed mirrors of the legacy counters/dict
@@ -396,12 +441,72 @@ class Node:
 
     # ------------------------------------------------------------------
 
+    def _sync_membership(self) -> None:
+        """Reconcile the node's address maps, gossip selector and
+        metrics with the engine's membership log (membership plane).
+        Called after every consensus run and after any engine swap —
+        the log is consensus state, so entries arrive in the same order
+        on every node, and processing is idempotent per index."""
+        log = getattr(self.core.hg, "membership_log", ())
+        while self._membership_seen < len(log):
+            entry = log[self._membership_seen]
+            self._membership_seen += 1
+            self._m_transitions.inc()
+            pub, addr, kind = entry["pub"], entry["addr"], entry["kind"]
+            if kind == "join":
+                if pub == self.core.pub_hex:
+                    self.core.adopt_membership()
+                    self.logger.warning(
+                        "epoch %s: this node JOINED the validator set "
+                        "(id %d) at round %d", entry["epoch"],
+                        self.core.id, entry["boundary"],
+                    )
+                else:
+                    self._addr_pub[addr] = pub
+                    cid = self.core.participants.get(pub)
+                    if cid is not None:
+                        self._addr_cid[addr] = cid
+                    self.peer_selector.add_peer(
+                        Peer(net_addr=addr, pub_key_hex=pub)
+                    )
+                    self.logger.warning(
+                        "epoch %s: validator %s… joined at %s (round %d)",
+                        entry["epoch"], pub[:18], addr, entry["boundary"],
+                    )
+            else:
+                if pub == self.core.pub_hex:
+                    self.core.retire_membership()
+                    self.logger.warning(
+                        "epoch %s: this node LEFT the validator set at "
+                        "round %d; continuing as observer",
+                        entry["epoch"], entry["boundary"],
+                    )
+                else:
+                    # stop gossiping TO the departed member; inbound
+                    # straggler events remain decodable (its column
+                    # and address book entry stay)
+                    for p in self.peer_selector.peers():
+                        if p.pub_key_hex == pub:
+                            self.peer_selector.remove_peer(p.net_addr)
+                    self.logger.warning(
+                        "epoch %s: validator %s… left (round %d)",
+                        entry["epoch"], pub[:18], entry["boundary"],
+                    )
+            self.core.refresh_quorums()
+
     def init(self) -> None:
         """Create the root event (reference node.go:105-112).  Skipped
-        when WAL recovery already restored a head, and deferred while
-        the seq probe negotiates (a node whose durable state vanished
-        must not mint seq 0 until a supermajority confirms nobody holds
-        a higher seq under our key)."""
+        when WAL recovery already restored a head, deferred while the
+        seq probe negotiates (a node whose durable state vanished must
+        not mint seq 0 until a supermajority confirms nobody holds a
+        higher seq under our key), and skipped entirely for an
+        observer (a joiner mints its root at the epoch boundary)."""
+        if self.core._observer:
+            self.logger.warning(
+                "not in the epoch's validator set: observing until a "
+                "join transition admits this key"
+            )
+            return
         if self.core.probing:
             self.logger.warning(
                 "WAL missing or truncated: deferring first mint until a "
@@ -929,16 +1034,20 @@ class Node:
             lcr = int(hg._lcr_cache)
             position = hg.commit_length
             digest = hg.commit_digest
+            epoch = int(getattr(hg, "epoch", 0))
             r, s = sign_snapshot_proof(
-                self.core.key, snapshot_hash(snap), lcr, position, digest
+                self.core.key, snapshot_hash(snap), lcr, position,
+                digest, epoch,
             )
         self.logger.info(
-            "served fast-forward snapshot (%d bytes, frontier %d) to %s",
-            len(snap), position, req.from_addr,
+            "served fast-forward snapshot (%d bytes, frontier %d, "
+            "epoch %d) to %s",
+            len(snap), position, epoch, req.from_addr,
         )
         return FastForwardResponse(
             from_addr=self.transport.local_addr(), snapshot=snap,
             lcr=lcr, position=position, digest=digest, sig_r=r, sig_s=s,
+            epoch=epoch,
         )
 
     async def _process_state_proof_request(
@@ -957,18 +1066,19 @@ class Node:
             hg = self.core.hg
             digest = None
             pos = req.position
+            epoch = int(getattr(hg, "epoch", 0))
             if pos >= 0 and hasattr(hg, "commit_digest_at"):
                 pos = min(pos, hg.commit_length)
                 digest = hg.commit_digest_at(pos)
             if digest is None:
                 return StateProofResponse(
                     from_addr=self.transport.local_addr(),
-                    position=req.position,
+                    position=req.position, epoch=epoch,
                 )
-            r, s = sign_attestation(self.core.key, pos, digest)
+            r, s = sign_attestation(self.core.key, pos, digest, epoch)
         return StateProofResponse(
             from_addr=self.transport.local_addr(), position=pos,
-            digest=digest, sig_r=r, sig_s=s,
+            digest=digest, sig_r=r, sig_s=s, epoch=epoch,
         )
 
     # ------------------------------------------------------------------
@@ -1028,21 +1138,42 @@ class Node:
     def validate_ff_snapshot(self, engine) -> None:
         """Trust boundary for catch-up (ADVICE r2 high): snapshot trust
         extends to *ordering metadata only*, never membership.  A snapshot
-        whose participant set differs from our canonical local peers.json
-        could swap in a fabricated validator set whose self-consistent
-        signatures pass every later check — reject it outright.
+        whose participant set differs from what we can DERIVE could swap
+        in a fabricated validator set whose self-consistent signatures
+        pass every later check — reject it outright.
 
-        load_snapshot already enforces this on the declared meta before
-        materializing anything (the cheap-to-reject path); this re-check on
-        the restored engine is the belt-and-braces invariant the rest of
-        the runtime relies on."""
-        if engine.participants != self.core.participants:
-            raise ValueError(
-                "fast-forward snapshot participant set does not match "
-                "local peers ({} vs {} entries)".format(
-                    len(engine.participants), len(self.core.participants)
+        With the membership plane the derivable set is no longer just
+        our boot peers.json: a snapshot from a later epoch carries its
+        membership log — a chain of SUBJECT-SIGNED transitions — and is
+        accepted exactly when replaying that chain on top of our
+        current trusted set yields its claimed peer set
+        (membership/epoch.verify_membership_chain).  The attestation
+        quorum then ties the chain to committed history (the
+        transitions are in the order the quorum co-signs).  A snapshot
+        at OUR epoch must still match our set exactly."""
+        local_epoch = int(getattr(self.core.hg, "epoch", 0))
+        snap_epoch = int(getattr(engine, "epoch", 0))
+        if snap_epoch == local_epoch:
+            if engine.participants != self.core.participants:
+                raise ValueError(
+                    "fast-forward snapshot participant set does not "
+                    "match local peers ({} vs {} entries)".format(
+                        len(engine.participants),
+                        len(self.core.participants),
+                    )
                 )
+        else:
+            from ..membership.epoch import verify_membership_chain
+
+            local_retired = tuple(
+                getattr(getattr(self.core.hg, "cfg", None), "retired", ())
             )
+            err = verify_membership_chain(
+                self.core.participants, local_retired, local_epoch,
+                engine,
+            )
+            if err is not None:
+                raise ValueError(f"fast-forward membership chain: {err}")
         from ..store.checkpoint import engine_mode
 
         # engine KIND must match: a fused node must not adopt a wide
@@ -1078,14 +1209,28 @@ class Node:
                 f"fast-forward snapshot capacities out of bounds: {cap}"
             )
 
-    def _ff_proof_quorum(self) -> int:
+    def _ff_proof_quorum(self, engine=None) -> int:
         """Matching signed digests required to adopt a snapshot
-        (responder included): with fewer than a third of participants
-        byzantine, any n//3 + 1 matching signers include an honest
-        node, so a rewritten history can never gather a quorum."""
+        (responder included): with fewer than a third of the active set
+        byzantine, any attestation_quorum(n) matching signers include
+        an honest node, so a rewritten history can never gather a
+        quorum.  ``n`` is the SNAPSHOT epoch's active count when an
+        engine is given — the set that actually attests — else the
+        local epoch's."""
+        from ..membership.quorum import attestation_quorum
+
         if self.conf.ff_proof_quorum is not None:
             return max(1, self.conf.ff_proof_quorum)
-        return len(self.core.participants) // 3 + 1
+        n = None
+        if engine is not None:
+            cfg = getattr(engine, "cfg", None)
+            if cfg is not None and hasattr(cfg, "active_n"):
+                n = cfg.active_n
+            else:
+                n = len(engine.participants)
+        if n is None:
+            n = self.core._active_count()
+        return attestation_quorum(n)
 
     def _verify_ff_responder(self, peer_addr: str,
                              resp: FastForwardResponse) -> None:
@@ -1099,9 +1244,16 @@ class Node:
             raise FFProofError(f"responder {peer_addr} is not a known peer")
         if not resp.digest:
             raise FFProofError("response carries no signed state proof")
+        if resp.epoch < int(getattr(self.core.hg, "epoch", 0)):
+            # a snapshot from an OLDER epoch can never be adoptable
+            # (its peer set is behind ours) — reject before parsing
+            raise FFProofError(
+                f"snapshot epoch {resp.epoch} behind local epoch "
+                f"{getattr(self.core.hg, 'epoch', 0)}"
+            )
         if not verify_snapshot_proof(
             pub, snapshot_hash(resp.snapshot), resp.lcr, resp.position,
-            resp.digest, resp.sig_r, resp.sig_s,
+            resp.digest, resp.sig_r, resp.sig_s, resp.epoch,
         ):
             raise FFProofError("responder proof signature invalid")
 
@@ -1123,7 +1275,7 @@ class Node:
         from ..consensus.digest import fold
         from ..store.proof import verify_attestation
 
-        needed = self._ff_proof_quorum()
+        needed = self._ff_proof_quorum(engine)
         have = 1   # the responder's own signature
         local = self.transport.local_addr()
         dg = engine._digest
@@ -1143,7 +1295,8 @@ class Node:
             *(self.transport.request(
                 peer,
                 StateProofRequest(from_addr=local,
-                                  position=resp.position),
+                                  position=resp.position,
+                                  epoch=resp.epoch),
                 timeout=self.conf.tcp_timeout,
             ) for peer in attesters),
             return_exceptions=True,
@@ -1161,6 +1314,17 @@ class Node:
             if not att.digest or apub is None \
                     or att.position > resp.position:
                 continue
+            # epoch discipline (membership plane): an attestation from
+            # the WRONG epoch is a reject.  At the snapshot's frontier
+            # the attester must be at the snapshot's epoch (same
+            # position, different peer set = different history); a
+            # lagging attester may be at an earlier epoch — its digest
+            # vouches for the shared prefix — but never a later one at
+            # a lower position.
+            if att.position == resp.position and att.epoch != resp.epoch:
+                continue
+            if att.position < resp.position and att.epoch > resp.epoch:
+                continue
             if att.position == resp.position:
                 expected = resp.digest
             elif (dg.anchor is not None and dg.anchor_pos == start
@@ -1169,7 +1333,8 @@ class Node:
             else:
                 continue   # attester frontier below the snapshot window
             if att.digest == expected and verify_attestation(
-                apub, att.position, att.digest, att.sig_r, att.sig_s
+                apub, att.position, att.digest, att.sig_r, att.sig_s,
+                att.epoch,
             ):
                 have += 1
         if have < needed:
@@ -1260,18 +1425,25 @@ class Node:
                     ),
                 }
             loop = asyncio.get_running_loop()
-            # membership + capacity bounds are enforced INSIDE
+            # capacity + participant-count bounds are enforced INSIDE
             # load_snapshot on the declared meta and the npy headers,
             # before any array decompresses or any signature verifies —
-            # a hostile snapshot must cost nothing to reject.  The load
-            # is pure construction (no core state), so it runs OUTSIDE
+            # a hostile snapshot must cost nothing to reject.  The
+            # exact membership check happens on the restored engine
+            # (validate_ff_snapshot): a later-epoch snapshot's set is
+            # verified against its signed membership chain, so an
+            # equality pre-check against OUR epoch's set would wrongly
+            # reject every legitimate churned snapshot.  The load is
+            # pure construction (no core state), so it runs OUTSIDE
             # the core lock, as does the attestation round-trip.
             engine = await loop.run_in_executor(
                 None,
                 lambda: load_snapshot(
                     resp.snapshot,
                     policy=policy,
-                    expected_participants=self.core.participants,
+                    max_participants=(
+                        len(self.core.participants) + 1024
+                    ),
                     max_caps=self.ff_max_caps(),
                 ),
             )
@@ -1290,8 +1462,15 @@ class Node:
                     raise FFProofError(err)
                 await self._verify_ff_quorum(peer_addr, resp, engine)
             async with self.core_lock:
-                self.validate_ff_snapshot(engine)
+                # off-loop: membership-chain verification decodes the
+                # log's embedded signed transitions (msgpack + ECDSA) —
+                # codec-on-loop discipline, and the crypto is real work
+                await loop.run_in_executor(
+                    None, self.validate_ff_snapshot, engine
+                )
                 self.core.bootstrap(engine)
+                # the adopted engine may be epochs ahead of our maps
+                self._sync_membership()
                 lost = self.core.last_bootstrap_lost_txs
                 if lost:
                     # an unrecoverable own-chain suffix was discarded
@@ -1435,6 +1614,8 @@ class Node:
             # enqueue under the lock: batches reach the committer in
             # consensus order even when gossip tasks overlap
             self._commit_queue.put_nowait(new_events)
+        # membership plane: the run may have applied an epoch boundary
+        self._sync_membership()
 
     async def _consensus_loop(self) -> None:
         """Dedicated consensus cadence (Config.consensus_interval > 0):

@@ -43,6 +43,21 @@ class RandomPeerSelector(PeerSelector):
     def peers(self) -> List[Peer]:
         return list(self._peers)
 
+    def add_peer(self, peer: Peer) -> None:
+        """Membership plane: admit a newly-joined validator as a
+        gossip target (idempotent; self never added)."""
+        if peer.net_addr == self.local_addr:
+            return
+        if any(p.net_addr == peer.net_addr for p in self._peers):
+            return
+        self._peers.append(peer)
+
+    def remove_peer(self, addr: str) -> None:
+        """Membership plane: stop gossiping to a departed validator."""
+        _, self._peers = exclude_peer(self._peers, addr)
+        if self.last == addr:
+            self.last = None
+
     def next(self) -> Optional[Peer]:
         candidates = self._peers
         if len(candidates) > 1 and self.last is not None:

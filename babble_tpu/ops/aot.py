@@ -43,7 +43,7 @@ from .state import DagConfig, init_state
 #: bump when a change to the flush/ingest/fame/order kernels makes old
 #: manifest entries meaningless (the persistent XLA cache keys on HLO
 #: and self-invalidates; this guards OUR shape replay layer)
-ENGINE_CACHE_VERSION = "7.0"
+ENGINE_CACHE_VERSION = "8.0"
 
 _MANIFEST = "babble_aot_manifest.json"
 
@@ -139,7 +139,9 @@ def configure(cache_dir: str) -> None:
 # shape manifest
 
 def _cfg_key(cfg: DagConfig) -> list:
-    return list(cfg)
+    # JSON round-trips tuples (the membership plane's retired columns)
+    # as lists — normalize so manifest comparison survives reload
+    return [list(v) if isinstance(v, tuple) else v for v in cfg]
 
 
 def manifest_path(cache_dir: str) -> str:
@@ -159,9 +161,9 @@ def load_manifest(cache_dir: str) -> List[dict]:
     return entries if isinstance(entries, list) else []
 
 
-def record_shape(cache_dir: str, cfg: DagConfig, key: tuple) -> None:
-    """Append one compiled live-flush shape (idempotent; best-effort —
-    a read-only cache dir only loses prewarm).  The read-modify-replace
+def _record_entry(cache_dir: str, entry: dict) -> None:
+    """Append one manifest entry (idempotent; best-effort — a
+    read-only cache dir only loses prewarm).  The read-modify-replace
     runs under an flock'd sidecar: fleet nodes share one cache dir, and
     without the lock concurrent writers drop each other's entries
     (last-writer-wins), silently re-arming the compile storm the
@@ -173,7 +175,6 @@ def record_shape(cache_dir: str, cfg: DagConfig, key: tuple) -> None:
         with open(manifest_path(cache_dir) + ".lock", "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
             entries = load_manifest(cache_dir)
-            entry = {"cfg": _cfg_key(cfg), "key": list(key)}
             if entry in entries:
                 return
             entries.append(entry)
@@ -184,6 +185,30 @@ def record_shape(cache_dir: str, cfg: DagConfig, key: tuple) -> None:
             os.replace(tmp, manifest_path(cache_dir))
     except (OSError, ImportError):
         pass
+
+
+def record_shape(cache_dir: str, cfg: DagConfig, key: tuple) -> None:
+    """Record one compiled fused live-flush shape."""
+    _record_entry(cache_dir, {"cfg": _cfg_key(cfg), "key": list(key)})
+
+
+def record_fork_caps(cache_dir: str, n: int, k: int, caps: tuple,
+                     sched: Optional[tuple] = None) -> None:
+    """Record a fork pipeline's compiled shape: the monotone capacity
+    triple plus the bucketed level-schedule dims (the byzantine engine
+    compiles one whole pipeline per (n, k, caps, sched))."""
+    entry = {"kind": "fork", "n": int(n), "k": int(k),
+             "caps": [int(c) for c in caps]}
+    if sched is not None:
+        entry["sched"] = [int(s) for s in sched]
+    _record_entry(cache_dir, entry)
+
+
+def record_wide_cfg(cache_dir: str, cfg: DagConfig, n_blocks: int) -> None:
+    """Record a wide engine's config + block layout (its fixed-shape
+    fame/order/march programs are keyed on exactly this)."""
+    _record_entry(cache_dir, {"kind": "wide", "cfg": _cfg_key(cfg),
+                              "n_blocks": int(n_blocks)})
 
 
 # ----------------------------------------------------------------------
@@ -218,25 +243,38 @@ def _batch_struct(kpad: int, tb: Tuple[int, int]):
 def prewarm_engine(engine, cache_dir: str,
                    defaults: bool = True,
                    limit: Optional[int] = None) -> Dict[str, int]:
-    """AOT-compile the live-flush programs this engine will need.
+    """AOT-compile the programs this engine will need, by engine kind.
 
-    Replays the manifest entries recorded for this exact
-    (DagConfig, ENGINE_CACHE_VERSION) — plus the default gossip shapes
-    when the manifest holds none — into the engine's executable map.
+    **Fused** engines replay the manifest's live-flush shape entries
+    for this exact (DagConfig, ENGINE_CACHE_VERSION) — plus the default
+    gossip shapes when the manifest holds none — into the engine's
+    executable map.  **Fork** (byzantine) engines pre-size to the
+    manifest's recorded pipeline capacities and run one warmup pass, so
+    the whole-pipeline jit happens at boot instead of the first gossip
+    tick.  **Wide** engines run one warmup consensus pass over the
+    freshly-allocated (empty) state, compiling the fixed-shape
+    march/fame/order programs their first real flush would otherwise
+    pay for (per-batch coordinate kernels stay demand-compiled — they
+    are small and bucket-shared).
+
     With a populated persistent cache the XLA work is a deserialize,
     so a fleet restart reaches its first flush in seconds; cold, this
     is the same compile the first flush would have paid, just moved
     to boot where it cannot stall gossip.  ``limit`` caps how many
-    manifest entries prewarm (oldest first — manifest order is usage
-    order, so early entries are the shapes the first flushes hit);
-    later shapes still deserialize from the persistent cache on first
-    use, they just pay their trace mid-stream instead of at boot.
+    fused manifest entries prewarm (oldest first — manifest order is
+    usage order, so early entries are the shapes the first flushes
+    hit); later shapes still deserialize from the persistent cache on
+    first use, they just pay their trace mid-stream instead of at boot.
 
     Returns {"compiled": n, "from_manifest": m}."""
     from . import flush as flush_ops
 
     configure(cache_dir)
     engine._aot_dir = cache_dir
+    if hasattr(engine, "pre_size") and hasattr(engine, "k"):
+        return _prewarm_fork(engine, cache_dir)
+    if hasattr(engine, "stream"):
+        return _prewarm_wide(engine, cache_dir)
     cfg = engine.cfg
     gate = engine.finality_gate
 
@@ -269,3 +307,86 @@ def prewarm_engine(engine, cache_dir: str,
         engine._aot_recorded.add(key)
         compiled += 1
     return {"compiled": compiled, "from_manifest": from_manifest}
+
+
+def _prewarm_fork(engine, cache_dir: str) -> Dict[str, int]:
+    """Byzantine-engine prewarm (the KERNEL_SPLIT-gate leftover,
+    ROADMAP 3c): pre-size to the largest recorded pipeline capacities
+    for this (n, k), then trace-and-compile the pipeline at those caps
+    for every recorded (bucketed) level-schedule shape, using synthetic
+    empty batches through the REAL jit entry — so a restarted node's
+    live ticks hit a warm jit cache (and, across processes, the
+    persistent XLA cache) instead of paying whole-pipeline compiles
+    mid-gossip.  Shapes are replayed at the MERGED max caps because
+    that is what the presized engine will actually call with."""
+    import jax.numpy as jnp
+
+    from .forks import ForkBatch, ForkConfig, fork_pipeline
+
+    caps = None
+    scheds = set()
+    from_manifest = 0
+    for e in load_manifest(cache_dir):
+        if (e.get("kind") == "fork" and e.get("n") == engine.n
+                and e.get("k") == engine.k):
+            c = tuple(int(v) for v in e.get("caps", ()))
+            if len(c) == 3:
+                caps = c if caps is None else tuple(
+                    max(a, b) for a, b in zip(caps, c)
+                )
+                from_manifest += 1
+            s = e.get("sched")
+            if isinstance(s, list) and len(s) == 2:
+                scheds.add((int(s[0]), int(s[1])))
+    if caps is None:
+        return {"compiled": 0, "from_manifest": 0}
+    engine.pre_size(caps)
+    cfg = ForkConfig(n=engine.n, k=engine.k, e_cap=caps[0],
+                     s_cap=caps[1], r_cap=caps[2])
+    e1, B, s1 = cfg.e_cap + 1, cfg.b, cfg.s_cap + 1
+    before = _stats["xla_compiles"]
+    compiled = 0
+    for (t, w) in sorted(scheds):
+        batch = ForkBatch(
+            sp=jnp.full((e1,), -1, jnp.int32),
+            op=jnp.full((e1,), -1, jnp.int32),
+            ebr=jnp.full((e1,), B, jnp.int32),
+            eseq=jnp.full((e1,), -1, jnp.int32),
+            ecr=jnp.full((e1,), cfg.n, jnp.int32),
+            ts=jnp.zeros((e1,), jnp.int64),
+            mbit=jnp.zeros((e1,), jnp.bool_),
+            sched=jnp.full((t, w), -1, jnp.int32),
+            cp=jnp.zeros((B, B), jnp.int32),
+            ce=jnp.full((B, s1), -1, jnp.int32),
+            cnt=jnp.zeros((B,), jnp.int32),
+            owner=jnp.zeros((B, s1), jnp.bool_),
+            n_events=jnp.asarray(0, jnp.int32),
+            rseed=jnp.full((e1,), -1, jnp.int32),
+            wseed=jnp.full((e1,), -1, jnp.int8),
+            s_off=jnp.zeros((B,), jnp.int32),
+        )
+        fork_pipeline(cfg, batch)   # populate jit + persistent caches
+        compiled += 1
+    return {"compiled": compiled,
+            "from_manifest": from_manifest,
+            "xla_compiles": _stats["xla_compiles"] - before}
+
+
+def _prewarm_wide(engine, cache_dir: str) -> Dict[str, int]:
+    """Wide-engine prewarm (the KERNEL_SPLIT-gate leftover, ROADMAP
+    3c): one warmup consensus pass over the freshly-allocated empty
+    state compiles the fixed-shape march/fame/order programs.  Fame and
+    order over an all-sentinel window are semantic no-ops (no
+    witnesses, no decisions), so the warmup cannot perturb consensus —
+    differentially covered by the prewarm parity test."""
+    from_manifest = sum(
+        1 for e in load_manifest(cache_dir)
+        if e.get("kind") == "wide" and e.get("cfg") == _cfg_key(engine.cfg)
+    )
+    record_wide_cfg(cache_dir, engine.cfg, engine.stream.C)
+    before = _stats["xla_compiles"]
+    engine.stream.consensus(final=False)
+    engine.state = engine.stream.state
+    engine._view = {}
+    return {"compiled": _stats["xla_compiles"] - before,
+            "from_manifest": from_manifest}

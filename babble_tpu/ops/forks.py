@@ -52,6 +52,7 @@ import numpy as np
 
 from ..core.event import Event
 from .state import I32, I64, INT32_MAX, sanitize
+from ..membership.quorum import supermajority
 
 F32 = jnp.float32
 
@@ -73,7 +74,7 @@ class ForkConfig(NamedTuple):
 
     @property
     def super_majority(self) -> int:
-        return 2 * self.n // 3 + 1
+        return supermajority(self.n)
 
 
 class ForkBatch(NamedTuple):
@@ -392,8 +393,19 @@ class ForkDag:
         order = np.argsort(lev, kind="stable")
         ulev, starts = np.unique(lev[order], return_index=True)
         bounds = list(starts) + [ne]
-        t = max(len(ulev), 1)
-        wid = max(int(np.max(np.diff(bounds))), 1) if len(ulev) else 1
+        # bucket the schedule dims to powers of two (state.bucket):
+        # exact (levels, widest-level) shapes change almost every
+        # consensus tick, and each distinct shape is a full pipeline
+        # re-trace — bucketing collapses the shape universe so a steady
+        # fleet reuses a handful of programs (and the AOT prewarm can
+        # replay them at boot).  Padding rows/lanes hold -1 slots the
+        # level scan already ignores, so outputs are bit-identical.
+        from .state import bucket as _bkt
+
+        t = _bkt(max(len(ulev), 1), 1)
+        wid = _bkt(
+            max(int(np.max(np.diff(bounds))), 1) if len(ulev) else 1, 1
+        )
         sched = np.full((t, wid), -1, np.int32)
         for row in range(len(ulev)):
             grp = order[bounds[row] : bounds[row + 1]]

@@ -39,7 +39,8 @@ import jax.numpy as jnp
 
 from .ss import ss_counts
 from .state import (
-    INT32_MAX, DagConfig, DagState, I32, I64, sanitize, set_sentinel,
+    INT32_MAX, DagConfig, DagState, I32, I64, retired_mask, sanitize,
+    set_sentinel,
 )
 
 
@@ -325,11 +326,22 @@ def _rounds_level_scan(
     """Assign round + witness per topological level (hashgraph.go:211-305):
 
     parent_round = max(round[sp], round[op])      (roots: 0)
-    inc          = |{j : strongly_see(x, w_{parent_round, j})}| >= 2N/3+1
+    inc          = |{j : strongly_see(x, w_{parent_round, j})}| >= sm[pr]
     round        = parent_round + inc
     witness      = no self-parent, or round > round[sp]
+
+    The increment threshold is gathered PER PARENT ROUND from
+    ``state.sm`` (membership plane): round p's witness quorum belongs
+    to the epoch that owns round p, so an old-epoch straggler inserted
+    after an epoch transition is assigned the same round on every
+    replica.  Uniform configs (no transitions) gather a constant array
+    and behave exactly as the static ``cfg.super_majority`` did.
+    Retired creators' events never register in the witness tables of
+    rounds they are retired for (the static ``retired_mask`` dump) —
+    their chains are frozen history, not fame candidates.
     """
-    n, sm = cfg.n, cfg.super_majority
+    n = cfg.n
+    retired = jnp.asarray(retired_mask(cfg))       # trace-time constant
 
     def step(carry, sched_rows):
         rnd, wit, wslot, max_round = carry
@@ -348,8 +360,9 @@ def _rounds_level_scan(
         fdw = state.fd[sanitize(wsl, cfg.e_cap)]                  # [B, N, N]
         la_x = state.la[idx]                                      # [B, N]
         ss_cnt = (la_x[:, None, :] >= fdw).sum(-1)                # [B, N]
-        ss = (ss_cnt >= sm) & (wsl >= 0)
-        inc = ss.sum(-1) >= sm
+        sm_x = state.sm[jnp.clip(pr_loc, 0, cfg.r_cap)]           # [B]
+        ss = (ss_cnt >= sm_x[:, None]) & (wsl >= 0)
+        inc = ss.sum(-1) >= sm_x
         r_x = pr + inc.astype(I32)
         w_x = (state.sp[idx] < 0) | (r_x > rnd[spx])
 
@@ -359,9 +372,13 @@ def _rounds_level_scan(
         # parents both sit below the rolled round window; those rounds are
         # long decided, so (like the reference's pendingRounds pop) a late
         # witness there is never voted on — dump the write, never let the
-        # negative index clamp into row 0.
+        # negative index clamp into row 0.  Retired creators dump too:
+        # a departed member's events stay orderable ancestry but must
+        # not enter any NEW round's witness set.
         w_row = jnp.where(
-            w_x & real & (r_x >= state.r_off), r_x - state.r_off, cfg.r_cap
+            w_x & real & (r_x >= state.r_off)
+            & ~retired[jnp.clip(state.creator[idx], 0, n)],
+            r_x - state.r_off, cfg.r_cap,
         )
         w_col = jnp.clip(state.creator[idx], 0, n - 1)
         wslot = wslot.at[w_row, w_col].set(idx)

@@ -24,11 +24,13 @@ branches are a planned extension, SURVEY.md §5.2).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..membership.quorum import supermajority
 
 I32 = jnp.int32
 I64 = jnp.int64
@@ -64,7 +66,19 @@ class DagConfig(NamedTuple):
     bit-identical to the i64 path whenever the live timestamp SPAN
     fits int32 (ts32_ok) — true for logical clocks (sim, chaos, bench
     streams), never for wall-clock ns fleets, which keep i64.  The
-    engine enforces the span guard host-side before every flush."""
+    engine enforces the span guard host-side before every flush.
+
+    ``retired`` (membership plane) lists the participant columns of
+    members that LEFT at an epoch boundary.  The column stays (removing
+    it would renumber every other creator's coordinate column and
+    scramble la/fd history); what changes is arithmetic: ``active_n``
+    shrinks, so every supermajority threshold derived from this config
+    tightens to the live set, the witness tables stop registering the
+    retired creator's events (ops/ingest.py) and the finality gate
+    stops waiting on its frozen chain (``head_round_min_math``).
+    Retired columns contribute nothing to NEW quorum paths
+    automatically: a strongly-see through creator c requires c to mint
+    a descendant, which a departed member does not."""
 
     n: int          # participants (array width, possibly mesh-padded)
     e_cap: int      # event slot capacity
@@ -74,14 +88,21 @@ class DagConfig(NamedTuple):
     coord16: bool = False
     coord8: bool = False     # overrides coord16 (shallowest chains only)
     ts32: bool = False       # i32 relative timestamps in the order median
+    retired: Tuple[int, ...] = ()   # columns of departed members
 
     @property
-    def active_n(self) -> int:
+    def n_cols(self) -> int:
+        """True participant-axis width (mesh padding excluded) — the
+        column count retired members still occupy."""
         return self.n_real or self.n
 
     @property
+    def active_n(self) -> int:
+        return self.n_cols - len(self.retired)
+
+    @property
     def super_majority(self) -> int:
-        return 2 * self.active_n // 3 + 1
+        return supermajority(self.active_n)
 
     @property
     def coord_dtype(self):
@@ -96,6 +117,19 @@ class DagConfig(NamedTuple):
         must stay on the safe side."""
         return np.asarray(np.iinfo(np.dtype(self.coord_dtype)).max,
                           np.dtype(self.coord_dtype))[()]
+
+
+def config_from_fields(fields) -> DagConfig:
+    """Rebuild a DagConfig from its serialized field list (checkpoint
+    meta / AOT manifest).  msgpack/json round-trip the ``retired``
+    tuple as a list — normalize it back or the config is unhashable
+    and every jit closure over it fails."""
+    cfg = DagConfig(*fields)
+    if not isinstance(cfg.retired, tuple):
+        cfg = cfg._replace(
+            retired=tuple(int(c) for c in (cfg.retired or ()))
+        )
+    return cfg
 
 
 def coord16_ok(s_cap: int) -> bool:
@@ -158,6 +192,16 @@ class DagState(NamedTuple):
     # per-round (creator-indexed witnesses)
     wslot: jnp.ndarray     # i32[R+1, N]    witness slot, -1 = none
     famous: jnp.ndarray    # i8[R+1, N]     trilean
+    # per-round supermajority threshold for round-increment evaluation
+    # (membership plane): sm[r_loc] is the quorum an event whose max
+    # parent round is r_off + r_loc must strongly-see among that
+    # round's witnesses to increment.  Uniform (= cfg.super_majority)
+    # until an epoch transition; across a boundary the old epoch's
+    # rounds KEEP their old threshold so a straggler event inserted
+    # after the transition is assigned the same round on every replica
+    # regardless of which side of the apply it arrived on.  Row r_cap
+    # is the backfill default compact() rolls in for fresh rounds.
+    sm: jnp.ndarray        # i32[R+1]
 
     # scalars
     n_events: jnp.ndarray  # i32  live (windowed) event count
@@ -205,6 +249,7 @@ def init_state(cfg: DagConfig,
         cnt=jnp.zeros((n + 1,), I32),
         wslot=jnp.full((r1, n), -1, I32),
         famous=jnp.zeros((r1, n), jnp.int8),
+        sm=jnp.full((r1,), cfg.super_majority, I32),
         n_events=jnp.zeros((), I32),
         max_round=jnp.full((), -1, I32),
         lcr=jnp.full((), -1, I32),
@@ -244,6 +289,7 @@ def grow_state(state: DagState, old: DagConfig, new: DagConfig) -> DagState:
         cnt=fresh.cnt.at[: old.n + 1].set(state.cnt),
         wslot=fresh.wslot.at[: old.r_cap].set(state.wslot[: old.r_cap]),
         famous=fresh.famous.at[: old.r_cap].set(state.famous[: old.r_cap]),
+        sm=fresh.sm.at[: old.r_cap].set(state.sm[: old.r_cap]),
         n_events=state.n_events,
         max_round=state.max_round,
         lcr=state.lcr,
@@ -301,6 +347,9 @@ def compact_impl(
         ce=ce,
         wslot=remap(state.wslot[ridx]),
         famous=state.famous[ridx],
+        # fresh rounds inherit the CURRENT epoch's threshold from the
+        # sentinel row; rolled-off old-epoch rows are decided history
+        sm=state.sm[ridx],
         n_events=state.n_events - de,
         e_off=state.e_off + de,
         s_off=new_s_off,
@@ -338,14 +387,31 @@ def head_round_min_math(cfg: DagConfig, state: DagState) -> jnp.ndarray:
     excluded from the minimum, so the fleet resumes committing K
     rounds after a peer goes dark, while the slow-but-live peers the
     gate exists for (chaos slow-peer: delays of a round or two) keep
-    blocking decisions exactly as the strict gate would."""
-    n = cfg.active_n
+    blocking decisions exactly as the strict gate would.
+
+    Retired columns (membership plane) are excluded outright: a
+    departed member's chain head is frozen forever, and without the
+    mask every leave would stall commits for HEAD_GATE_HORIZON rounds
+    before the staleness cutoff caught up."""
+    n = cfg.n_cols
     cnt_w = state.cnt[:n] - state.s_off[:n]
     heads = state.ce[jnp.arange(n), jnp.clip(cnt_w - 1, 0, cfg.s_cap)]
     hr = state.round[sanitize(jnp.where(cnt_w > 0, heads, -1), cfg.e_cap)]
     hr = jnp.where(state.cnt[:n] > 0, hr, -1)
     stale = hr + HEAD_GATE_HORIZON < state.max_round
+    if cfg.retired:
+        stale = stale | jnp.asarray(retired_mask(cfg)[:n])
     return jnp.min(jnp.where(stale, INT32_MAX, hr))
+
+
+def retired_mask(cfg: DagConfig) -> np.ndarray:
+    """bool[N+1] trace-time constant marking retired participant
+    columns (the +1 row covers the sentinel creator id ``n``).  All
+    False — and therefore free at trace time — for epoch-0 configs."""
+    mask = np.zeros(cfg.n + 1, bool)
+    if cfg.retired:
+        mask[list(cfg.retired)] = True
+    return mask
 
 
 def bucket(x: int, minimum: int = 8) -> int:

@@ -47,6 +47,7 @@ def state_specs() -> DagState:
         round=ev, witness=ev, rr=ev, cts=ev,
         ce=P(), cnt=P(),
         wslot=P(None, "p"), famous=P(None, "p"),
+        sm=P(),
         n_events=P(), max_round=P(), lcr=P(),
         e_off=P(), s_off=P(), r_off=P(),
     )

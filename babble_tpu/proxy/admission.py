@@ -28,11 +28,18 @@ shared anonymous client id — real ingress goes through
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import OrderedDict, deque
 from typing import Deque, Dict, Optional
 
 #: client id used by queue-compat ``put``/``put_nowait`` callers
 ANON_CLIENT = "<anon>"
+
+#: adaptive-cap EWMA window (seconds) and smoothing factor: the drain
+#: rate is sampled per window and folded with DRAIN_ALPHA weight on the
+#: newest sample — a few windows to converge, stable under bursts
+DRAIN_WINDOW_S = 0.5
+DRAIN_ALPHA = 0.3
 
 
 class OverloadedError(Exception):
@@ -80,11 +87,34 @@ class AdmissionQueue:
     """Bounded, per-client-fair submit queue (see module docstring)."""
 
     def __init__(self, per_client: int = 1024, total: int = 8192,
-                 registry=None):
+                 registry=None, adaptive: bool = False,
+                 horizon_s: float = 2.0, min_total: int = 64,
+                 max_total: Optional[int] = None):
+        """``adaptive=True`` (ROADMAP 1c leftover) derives the caps
+        from the OBSERVED commit drain rate instead of static config:
+        the queue admits at most ``horizon_s`` seconds of drain (EWMA
+        over DRAIN_WINDOW_S samples), clamped to [min_total,
+        max_total].  A node that drains 10k tx/s offers a deep queue; a
+        node wedged behind consensus backpressure shrinks toward
+        min_total and sheds — which is the point: queued work the node
+        cannot drain is just latency the client pays.  The static
+        ``per_client``/``total`` remain the COLD-START caps until the
+        first drain window completes, and per-client fairness becomes a
+        dynamic equal share of the effective total."""
         if per_client <= 0 or total <= 0:
             raise ValueError("admission caps must be positive")
+        if adaptive and (horizon_s <= 0 or min_total <= 0):
+            raise ValueError("adaptive admission bounds must be positive")
         self.per_client = per_client
         self.total = total
+        self.adaptive = adaptive
+        self.horizon_s = horizon_s
+        self.min_total = min_total
+        self.max_total = max_total if max_total is not None else total
+        #: EWMA of the drain rate (tx/s); None until one window closes
+        self._drain_ewma: Optional[float] = None
+        self._win_start = time.monotonic()
+        self._win_drained = 0
         #: client -> FIFO; OrderedDict preserves round-robin rotation
         #: order (move_to_end after each drain turn)
         self._queues: "OrderedDict[str, Deque[bytes]]" = OrderedDict()
@@ -115,6 +145,65 @@ class AdmissionQueue:
             "babble_ingress_clients",
             "clients with a non-empty admission queue",
         ).set_function(lambda: len(self._queues))
+        registry.gauge(
+            "babble_ingress_total_cap",
+            "total admission cap in force (drain-rate-derived when "
+            "adaptive, else the static config)",
+        ).set_function(self.effective_total)
+        registry.gauge(
+            "babble_ingress_drain_rate",
+            "EWMA of the observed drain rate (tx/s; 0 until the first "
+            "adaptive window closes)",
+        ).set_function(lambda: self._drain_ewma or 0.0)
+
+    # ------------------------------------------------------------------
+    # adaptive caps (drain-rate EWMA)
+
+    def _note_drain(self, n: int = 1) -> None:
+        """Fold drained txs into the rate EWMA.  Called by get_nowait
+        (the drain side IS the observation point) and with n=0 by
+        submit_nowait, so a FULLY wedged drain still closes windows and
+        decays the rate toward zero — without that, a node that stopped
+        draining would keep admitting at its last healthy cap."""
+        if not self.adaptive:
+            return
+        self._win_drained += n
+        now = time.monotonic()
+        dt = now - self._win_start
+        if dt >= DRAIN_WINDOW_S:
+            if self._win_drained == 0 and self._size == 0:
+                # IDLE window: nothing was queued, so nothing could
+                # drain — a zero sample here is not evidence of a
+                # wedged drain, and folding it would collapse the cap
+                # to min_total on the first burst after any quiet
+                # stretch.  Re-arm the window without sampling.
+                self._win_start = now
+                return
+            rate = self._win_drained / dt
+            self._drain_ewma = (
+                rate if self._drain_ewma is None
+                else DRAIN_ALPHA * rate
+                + (1 - DRAIN_ALPHA) * self._drain_ewma
+            )
+            self._win_start = now
+            self._win_drained = 0
+
+    def effective_total(self) -> int:
+        """The total cap in force: ``horizon_s`` seconds of observed
+        drain when adaptive (clamped), else the static cap."""
+        if not self.adaptive or self._drain_ewma is None:
+            return self.total
+        derived = int(self._drain_ewma * self.horizon_s)
+        return max(self.min_total, min(derived, self.max_total))
+
+    def effective_per_client(self) -> int:
+        """Per-client cap: an equal share of the effective total across
+        clients with backlog (floor 8 so a fresh client always gets a
+        foot in the door), else the static cap."""
+        if not self.adaptive or self._drain_ewma is None:
+            return self.per_client
+        share = self.effective_total() // max(1, len(self._queues))
+        return max(8, share)
 
     # ------------------------------------------------------------------
     # ingress side
@@ -122,15 +211,18 @@ class AdmissionQueue:
     def submit_nowait(self, client: str, tx: bytes) -> None:
         """Admit one transaction for ``client`` or shed it with a
         structured OverloadedError."""
-        if self._size >= self.total:
+        self._note_drain(0)   # close stale windows: no drain = decay
+        total = self.effective_total()
+        if self._size >= total:
             if self._m_shed is not None:
                 self._m_shed.labels("total").inc()
-            raise OverloadedError("total", self._size, self.total)
+            raise OverloadedError("total", self._size, total)
+        per_client = self.effective_per_client()
         q = self._queues.get(client)
-        if q is not None and len(q) >= self.per_client:
+        if q is not None and len(q) >= per_client:
             if self._m_shed is not None:
                 self._m_shed.labels("client").inc()
-            raise OverloadedError("client", len(q), self.per_client)
+            raise OverloadedError("client", len(q), per_client)
         if q is None:
             q = deque()
             self._queues[client] = q
@@ -163,6 +255,7 @@ class AdmissionQueue:
                 continue
             tx = q.popleft()
             self._size -= 1
+            self._note_drain()
             if q:
                 self._queues.move_to_end(client)
             else:

@@ -22,12 +22,15 @@ from .jsonrpc import JsonRpcClient, JsonRpcServer, b64d, b64e
 class SocketAppProxy:
     def __init__(self, client_addr: str, bind_addr: str, timeout: float = 5.0,
                  submit_per_client: int = 1024, submit_total: int = 8192,
-                 registry=None):
+                 registry=None, submit_adaptive: bool = False):
         """client_addr: the app's State server; bind_addr: where we listen
-        for the app's SubmitTx calls."""
+        for the app's SubmitTx calls.  ``submit_adaptive`` derives the
+        admission caps from the observed commit drain rate (EWMA)
+        instead of the static numbers — the millions-of-submitters
+        posture, where hand-tuned caps are always wrong somewhere."""
         self.submit_queue = AdmissionQueue(
             per_client=submit_per_client, total=submit_total,
-            registry=registry,
+            registry=registry, adaptive=submit_adaptive,
         )
         self.server = JsonRpcServer(bind_addr)
         self.server.register("Babble.SubmitTx", self._submit_tx,

@@ -20,6 +20,7 @@ from typing import Dict, Tuple
 import numpy as np
 
 from .. import native
+from ..membership.quorum import supermajority
 
 _BASE_TS = 1_700_000_000_000_000_000
 _MASK64 = (1 << 64) - 1
@@ -299,7 +300,7 @@ def random_byzantine_fork_batch(
     rng = np.random.default_rng(seed)
     k = 2
     b_total = n * k
-    n_byz = min(int(byz_frac * n), n - (2 * n // 3 + 1))
+    n_byz = min(int(byz_frac * n), n - supermajority(n))
 
     sp = np.full(n_events, -1, np.int32)
     op = np.full(n_events, -1, np.int32)

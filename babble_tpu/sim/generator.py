@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..core.event import Event, new_event
+from ..membership.quorum import supermajority
 
 
 @dataclass
@@ -117,7 +118,7 @@ def random_byzantine_dag(
     # BFT bound: once a creator's fork is visible, nobody can see its
     # events, so rounds only advance while the *honest* creators alone
     # reach a supermajority — cap forkers at n - (2n/3+1) (< n/3 strict)
-    n_byz = min(int(byz_frac * n), n - (2 * n // 3 + 1))
+    n_byz = min(int(byz_frac * n), n - supermajority(n))
 
     events: List[Event] = []
     # per creator: list of (hex, index) of every own event (fork targets)

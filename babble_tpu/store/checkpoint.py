@@ -30,9 +30,13 @@ import numpy as np
 from ..common import OffsetList
 from ..consensus.engine import TpuHashgraph
 from ..core.event import Event
-from ..ops.state import DagConfig, DagState
+from ..ops.state import DagConfig, DagState, config_from_fields
 
-FORMAT_VERSION = 3
+#: v4 (membership plane): cfg grew the ``retired`` field, DagState the
+#: per-round ``sm`` threshold array, and the meta carries
+#: epoch/membership_log/pending_membership.  v2/v3 checkpoints restore
+#: with epoch-0 defaults (sm backfilled uniform).
+FORMAT_VERSION = 4
 
 _META = "meta.msgpack"
 _DEVICE = "device.npz"
@@ -75,6 +79,16 @@ def _build_meta(engine: TpuHashgraph) -> dict:
         # frontier + its window anchor must survive restart or a
         # resumed responder could neither attest nor serve proofs
         "digest": engine._digest.to_meta(),
+        # membership plane: the epoch ledger.  The log's embedded signed
+        # transitions are what lets a fast-forward joiner verify a peer
+        # set it has never seen against its trusted bootstrap set; the
+        # pending entry keeps a mid-transition crash consistent.
+        "epoch": engine.epoch,
+        "membership_log": [dict(e) for e in engine.membership_log],
+        "pending_membership": (
+            dict(engine.pending_membership)
+            if engine.pending_membership else None
+        ),
         "slot_base": dag.slot_base,
         "events": [_pack_event(ev) for ev in dag.events],  # window, slot order
         "levels": list(dag.levels),
@@ -435,6 +449,69 @@ def _check_host_meta(meta: dict) -> None:
                 f"chain window start {chains[cid][0]}"
             )
     CommitDigest.check_meta(meta.get("digest"))
+    # membership plane (v4): epoch ledger bounds.  The chain-of-custody
+    # verification itself (signatures, set derivation) happens in
+    # node.validate_ff_snapshot via membership.epoch — here only the
+    # cheap structural rejection before any object is built.
+    from ..membership.epoch import MAX_LOG, check_log_entry
+
+    epoch = meta.get("epoch", 0)
+    if not isinstance(epoch, int) or not (0 <= epoch <= 1 << 32):
+        raise ValueError(f"snapshot epoch={epoch!r} out of bounds")
+    log = meta.get("membership_log", [])
+    if not isinstance(log, list) or len(log) > MAX_LOG:
+        raise ValueError("snapshot membership log out of bounds")
+    for entry in log:
+        err = check_log_entry(entry)
+        if err is not None:
+            raise ValueError(f"snapshot {err}")
+    if len(log) > epoch:
+        raise ValueError(
+            f"snapshot membership log ({len(log)} entries) longer than "
+            f"its epoch {epoch}"
+        )
+    pend = meta.get("pending_membership")
+    if pend is not None:
+        if not isinstance(pend, dict):
+            raise ValueError("snapshot pending_membership malformed")
+        for key, typ in (("kind", str), ("pub", str), ("addr", str),
+                         ("boundary", int), ("position", int)):
+            if not isinstance(pend.get(key), typ):
+                raise ValueError(
+                    f"snapshot pending_membership field {key} malformed"
+                )
+        tx = pend.get("tx")
+        if not isinstance(tx, (bytes, bytearray)) or len(tx) > 4096:
+            raise ValueError("snapshot pending_membership tx malformed")
+        # the pending transition is CONSUMED by apply_epoch_transition
+        # at the boundary — without re-verifying the embedded signed tx
+        # here, a byzantine responder could smuggle a validator join
+        # nobody signed (or an unauthorized leave) through an otherwise
+        # genuine, quorum-attested snapshot
+        from ..membership.transition import parse_membership_tx
+
+        spec = parse_membership_tx(bytes(tx))
+        if spec is None or (spec.kind, spec.pub_hex, spec.net_addr) != (
+                pend["kind"], pend["pub"], pend["addr"]):
+            raise ValueError(
+                "snapshot pending_membership contradicts its signed tx"
+            )
+        if not spec.verify():
+            raise ValueError(
+                "snapshot pending_membership tx has a bad subject "
+                "signature"
+            )
+    # retired columns (cfg field 9) must name real, unique columns
+    cfg_fields = meta.get("cfg", [])
+    retired = cfg_fields[8] if len(cfg_fields) > 8 else ()
+    if retired:
+        if (not isinstance(retired, (list, tuple))
+                or len(set(retired)) != len(retired)
+                or any(not isinstance(c, int) or not (0 <= c < n)
+                       for c in retired)):
+            raise ValueError(
+                f"snapshot retired columns {retired!r} out of bounds"
+            )
 
 
 def _pol(policy: dict, key: str, snap_val):
@@ -558,6 +635,7 @@ def _expected_layout(cfg: DagConfig) -> Dict[str, tuple]:
         "cts": (ev, i64),
         "ce": ((n + 1, s1), i32), "cnt": ((n + 1,), i32),
         "wslot": ((r1, n), i32), "famous": ((r1, n), i8),
+        "sm": ((r1,), i32),
         "n_events": (sc, i32), "max_round": (sc, i32), "lcr": (sc, i32),
         "e_off": (sc, i32), "s_off": ((n + 1,), i32), "r_off": (sc, i32),
     }
@@ -605,6 +683,7 @@ def load_snapshot(
     policy: Optional[dict] = None,
     expected_participants: Optional[Dict[str, int]] = None,
     max_caps: Optional[tuple] = None,
+    max_participants: Optional[int] = None,
 ) -> TpuHashgraph:
     """Reconstruct an engine from snapshot bytes (the fast-forward
     bootstrap).  The snapshot comes from a *peer*, so every event
@@ -632,6 +711,15 @@ def load_snapshot(
             "snapshot participant set does not match local peers "
             f"({len(participants)} vs {len(expected_participants)} entries)"
         )
+    if max_participants is not None and len(participants) > max_participants:
+        # membership plane: the exact set is verified against the
+        # snapshot's signed membership chain AFTER restore
+        # (node.validate_ff_snapshot); this is only the cheap
+        # reject-before-materializing size bound
+        raise ValueError(
+            f"snapshot declares {len(participants)} participants, "
+            f"bound {max_participants}"
+        )
     if meta.get("mode") == "byzantine":
         _check_fork_meta(meta, max_caps)
         engine = _restore_fork_engine(meta, commit_callback, policy)
@@ -644,7 +732,7 @@ def load_snapshot(
                     )
         return engine
     _check_host_meta(meta)
-    cfg = DagConfig(*meta["cfg"])
+    cfg = config_from_fields(meta["cfg"])
     if max_caps is not None:
         max_e, max_s, max_r = max_caps
         if cfg.e_cap > max_e or cfg.s_cap > max_s or cfg.r_cap > max_r:
@@ -660,6 +748,10 @@ def load_snapshot(
         layout = _peek_npz_layout(z)
         for name in expected:
             if name not in layout:
+                # pre-v4 snapshots carry no per-round threshold array;
+                # epoch-0 thresholds are uniform, so backfill is exact
+                if name == "sm" and meta["version"] < 4:
+                    continue
                 raise ValueError(f"snapshot missing array {name}")
             shape, dtype = layout[name]
             eshape, edtype = expected[name]
@@ -668,7 +760,8 @@ def load_snapshot(
                     f"snapshot array {name} is {dtype}{shape}, declared "
                     f"cfg implies {edtype}{eshape}"
                 )
-        arrays = {name: z[name] for name in expected}
+        arrays = {name: z[name] for name in expected if name in layout}
+    _backfill_sm(arrays, cfg)
     if wide:
         engine = _restore_wide_engine(meta, arrays, commit_callback, policy)
     else:
@@ -680,6 +773,15 @@ def load_snapshot(
                     f"snapshot event {ev.hex()[:18]}… has a bad signature"
                 )
     return engine
+
+
+def _backfill_sm(arrays: Dict[str, np.ndarray], cfg: DagConfig) -> None:
+    """Pre-v4 state carries no per-round threshold array; epoch-0
+    thresholds are uniform, so a constant backfill restores exactly the
+    semantics the static cfg.super_majority had."""
+    if "sm" not in arrays:
+        arrays["sm"] = np.full((cfg.r_cap + 1,), cfg.super_majority,
+                               np.int32)
 
 
 def load_checkpoint_tolerant(
@@ -710,15 +812,18 @@ def load_checkpoint(
     if meta.get("mode") == "byzantine":
         return _restore_fork_engine(meta, commit_callback)
     if meta.get("mode") == "wide":
-        cfg = DagConfig(*meta["cfg"])
+        cfg = config_from_fields(meta["cfg"])
         names = _expected_wide_layout(
             cfg, int(meta["n_blocks"]), bool(meta.get("has_carry"))
         )
         with np.load(os.path.join(path, _DEVICE)) as z:
-            arrays = {name: z[name] for name in names}
+            arrays = {name: z[name] for name in names if name in z.files}
+        _backfill_sm(arrays, cfg)
         return _restore_wide_engine(meta, arrays, commit_callback)
     with np.load(os.path.join(path, _DEVICE)) as z:
-        arrays = {name: z[name] for name in DagState._fields}
+        arrays = {name: z[name]
+                  for name in DagState._fields if name in z.files}
+    _backfill_sm(arrays, config_from_fields(meta["cfg"]))
     return _restore_engine(meta, arrays, commit_callback)
 
 
@@ -728,11 +833,12 @@ def _restore_engine(
     commit_callback: Optional[Callable] = None,
     policy: Optional[dict] = None,
 ) -> TpuHashgraph:
-    # v2 differs only by the missing coord16 cfg field (defaults False)
-    if meta["version"] not in (2, FORMAT_VERSION):
+    # v2 lacks the coord16 cfg field, v3 the membership-plane fields
+    # (retired cfg column, sm array, epoch ledger) — all default-filled
+    if meta["version"] not in (2, 3, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     from ..ops.state import coord8_ok, coord16_ok
-    cfg_chk = DagConfig(*meta["cfg"])
+    cfg_chk = config_from_fields(meta["cfg"])
     # the same soundness bounds init_state enforces: a peer-declared
     # narrow-coordinate config past them would carry already-wrapped
     # seqs that every later predicate silently miscounts
@@ -747,7 +853,7 @@ def _restore_engine(
     # capacities are shape facts of the serialized arrays; policy knobs
     # come from the snapshot for local checkpoints but are overridden by
     # the local node's values on the network path (load_snapshot)
-    cfg = DagConfig(*meta["cfg"])
+    cfg = config_from_fields(meta["cfg"])
     auto_compact, seq_window, round_margin, compact_min, cons_window = (
         meta["policy"][:5]
     )
@@ -782,6 +888,7 @@ def _restore_engine(
     )
     engine._r_off = int(np.asarray(engine.state.r_off))
     engine._lcr_cache = int(np.asarray(engine.state.lcr))
+    engine._max_round_cache = int(np.asarray(engine.state.max_round))
     return engine
 
 
@@ -825,6 +932,15 @@ def _restore_host(engine, meta: dict) -> None:
     engine.last_committed_round_events = meta["last_committed_round_events"]
     engine._ordered_total = meta["ordered_total"]
     engine._received = set(meta["received"])
+    # membership plane (v4; pre-v4 restores at epoch 0 with empty log)
+    engine.epoch = int(meta.get("epoch", 0))
+    engine.membership_log = [
+        {**e, "tx": bytes(e["tx"])} for e in meta.get("membership_log", [])
+    ]
+    pend = meta.get("pending_membership")
+    engine.pending_membership = (
+        {**pend, "tx": bytes(pend["tx"])} if pend else None
+    )
 
 
 def _restore_wide_engine(
@@ -839,13 +955,13 @@ def _restore_wide_engine(
     from ..consensus.wide_engine import WideHashgraph
     from ..ops.wide import MarchCarry
 
-    if meta["version"] not in (2, FORMAT_VERSION):
+    if meta["version"] not in (2, 3, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     policy = policy or {}
     participants: Dict[str, int] = {
         k: int(v) for k, v in meta["participants"]
     }
-    cfg = DagConfig(*meta["cfg"])
+    cfg = config_from_fields(meta["cfg"])
     auto_compact, seq_window, round_margin, compact_min, cons_window = (
         meta["policy"][:5]
     )

@@ -38,8 +38,12 @@ from typing import Optional
 from ..crypto import keys as crypto_keys
 from ..crypto.keys import KeyPair, sha256
 
-_SNAPSHOT_TAG = b"babble-ff-snapshot:v1"
-_ATTEST_TAG = b"babble-ff-attest:v1"
+#: v2 (membership plane): the consensus epoch is bound into both proof
+#: messages — a snapshot claiming one epoch's peer set under another
+#: epoch's digest, or an attestation replayed across an epoch
+#: boundary, fails signature verification outright
+_SNAPSHOT_TAG = b"babble-ff-snapshot:v2"
+_ATTEST_TAG = b"babble-ff-attest:v2"
 
 
 def snapshot_hash(snapshot: bytes) -> bytes:
@@ -47,51 +51,59 @@ def snapshot_hash(snapshot: bytes) -> bytes:
 
 
 def _snapshot_msg(snap_hash: bytes, lcr: int, position: int,
-                  digest: str) -> bytes:
+                  digest: str, epoch: int) -> bytes:
     return sha256(
         _SNAPSHOT_TAG + snap_hash
-        + struct.pack(">qQ", lcr, position) + digest.encode("ascii")
+        + struct.pack(">qQQ", lcr, position, epoch)
+        + digest.encode("ascii")
     )
 
 
-def _attest_msg(position: int, digest: str) -> bytes:
+def _attest_msg(position: int, digest: str, epoch: int) -> bytes:
     return sha256(
-        _ATTEST_TAG + struct.pack(">Q", position) + digest.encode("ascii")
+        _ATTEST_TAG + struct.pack(">QQ", position, epoch)
+        + digest.encode("ascii")
     )
 
 
 def sign_snapshot_proof(key: KeyPair, snap_hash: bytes, lcr: int,
-                        position: int, digest: str):
-    """Responder side: sign the (snapshot, frontier) binding."""
-    return key.sign_digest(_snapshot_msg(snap_hash, lcr, position, digest))
+                        position: int, digest: str, epoch: int = 0):
+    """Responder side: sign the (snapshot, frontier, epoch) binding."""
+    return key.sign_digest(
+        _snapshot_msg(snap_hash, lcr, position, digest, epoch)
+    )
 
 
 def verify_snapshot_proof(pub_hex: str, snap_hash: bytes, lcr: int,
                           position: int, digest: str,
-                          r: int, s: int) -> bool:
+                          r: int, s: int, epoch: int = 0) -> bool:
     try:
         pub = crypto_keys.from_pub_bytes(
             crypto_keys.pub_hex_to_bytes(pub_hex)
         )
         return crypto_keys.verify(
-            pub, _snapshot_msg(snap_hash, lcr, position, digest), r, s
+            pub, _snapshot_msg(snap_hash, lcr, position, digest, epoch),
+            r, s
         )
     except Exception:
         return False
 
 
-def sign_attestation(key: KeyPair, position: int, digest: str):
+def sign_attestation(key: KeyPair, position: int, digest: str,
+                     epoch: int = 0):
     """Attester side: co-sign a committed frontier you hold yourself."""
-    return key.sign_digest(_attest_msg(position, digest))
+    return key.sign_digest(_attest_msg(position, digest, epoch))
 
 
 def verify_attestation(pub_hex: str, position: int, digest: str,
-                       r: int, s: int) -> bool:
+                       r: int, s: int, epoch: int = 0) -> bool:
     try:
         pub = crypto_keys.from_pub_bytes(
             crypto_keys.pub_hex_to_bytes(pub_hex)
         )
-        return crypto_keys.verify(pub, _attest_msg(position, digest), r, s)
+        return crypto_keys.verify(
+            pub, _attest_msg(position, digest, epoch), r, s
+        )
     except Exception:
         return False
 

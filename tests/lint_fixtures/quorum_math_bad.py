@@ -1,0 +1,24 @@
+"""Fixture: stale-quorum-math — inlined quorum arithmetic that keeps
+enforcing a stale epoch's threshold after membership churn (the bug
+class dynamic membership makes possible; route through
+babble_tpu.membership.quorum instead)."""
+
+
+class StaleNode:
+    def __init__(self, participants, peers):
+        self.participants = participants
+        self.peers = peers
+
+    def super_majority(self):
+        n = len(self.participants)
+        return 2 * n // 3 + 1  # MARK: stale-quorum-math
+
+    def probe_quorum(self):
+        return 2 * len(self.peers) // 3  # MARK: stale-quorum-math
+
+    def proof_quorum(self):
+        return len(self.participants) // 3 + 1  # MARK: stale-quorum-math
+
+    def flipped_mult(self):
+        n = len(self.peers)
+        return n * 2 // 3  # MARK: stale-quorum-math

@@ -1,0 +1,37 @@
+"""Fixture: stale-quorum-math negative space — thresholds routed
+through the epoch-aware helpers, plus innocent divisions by 3 that a
+sloppier matcher would misfire on (capacity heuristics, averages)."""
+
+from babble_tpu.membership.quorum import (
+    attestation_quorum,
+    supermajority,
+    sync_quorum,
+)
+
+
+class EpochAwareNode:
+    def __init__(self, participants, retired):
+        self.participants = participants
+        self.retired = retired
+
+    def active_n(self):
+        return len(self.participants) - len(self.retired)
+
+    def super_majority(self):
+        return supermajority(self.active_n())
+
+    def probe_quorum(self):
+        return sync_quorum(self.active_n())
+
+    def proof_quorum(self):
+        return attestation_quorum(self.active_n())
+
+
+def window_heuristic(lvl_new):
+    # a capacity estimate that merely divides by 3 is NOT quorum math
+    return min(lvl_new, max(8, lvl_new // 3))
+
+
+def padded(levels_max):
+    # ... nor is // 3 + k for k != 1
+    return (levels_max // 3 + 4 - 1).bit_length()

@@ -89,6 +89,81 @@ def test_admission_round_robin_fairness():
     asyncio.run(go())
 
 
+def test_adaptive_admission_shed_then_recover(monkeypatch):
+    """ROADMAP 1c leftover: adaptive caps derive from the observed
+    drain rate.  Under a wedged drain the cap collapses toward
+    min_total and submits SHED; when draining resumes at speed the cap
+    grows back and the same client is admitted again — no static
+    number to hand-tune.  The clock is driven explicitly so the EWMA
+    windows are deterministic."""
+    from babble_tpu.proxy import admission as adm
+
+    t = {"now": 100.0}
+    monkeypatch.setattr(adm.time, "monotonic", lambda: t["now"])
+    q = AdmissionQueue(per_client=512, total=4096, adaptive=True,
+                       horizon_s=1.0, min_total=4, registry=Registry())
+    # cold start: static caps in force until a drain window closes
+    assert q.effective_total() == 4096
+    for i in range(64):
+        q.submit_nowait("c", b"x%d" % i)
+
+    # WEDGED drain: 2 tx/s observed over several windows -> the cap
+    # collapses to horizon_s * rate (clamped at min_total)
+    for _ in range(6):
+        t["now"] += adm.DRAIN_WINDOW_S
+        q.get_nowait()
+    assert q._drain_ewma is not None
+    assert q.effective_total() <= 8, q.effective_total()
+    # the backlog (58) sits far above the shrunken cap: submits shed
+    with pytest.raises(OverloadedError) as ei:
+        q.submit_nowait("c", b"over")
+    assert ei.value.scope == "total"
+    assert ei.value.cap == q.effective_total()
+
+    # RECOVERY: the node drains fast again (1000 tx/s) -> the cap
+    # grows with the EWMA and the same client is admitted again
+    for _ in range(40):
+        t["now"] += 0.001
+        q.get_nowait()
+        if q.qsize() == 0:
+            break
+    # refill windows at speed to converge the EWMA upward: each burst
+    # fills to the CURRENT cap and drains it within one window, so the
+    # observed rate (and with it the cap) compounds upward
+    for burst in range(20):
+        i = 0
+        while True:
+            try:
+                q.submit_nowait("c", b"r%d-%d" % (burst, i))
+                i += 1
+            except OverloadedError:
+                break
+        t["now"] += adm.DRAIN_WINDOW_S
+        while q.qsize():
+            q.get_nowait()
+    assert q.effective_total() > 100, q.effective_total()
+    q.submit_nowait("c", b"welcome-back")
+    assert q.qsize() == 1
+
+
+def test_adaptive_admission_ignores_idle_windows(monkeypatch):
+    """A quiet stretch (empty queue, nothing to drain) must not read
+    as a wedged drain: the first burst after idling is admitted at the
+    cold-start caps, not shed at min_total."""
+    from babble_tpu.proxy import admission as adm
+
+    t = {"now": 50.0}
+    monkeypatch.setattr(adm.time, "monotonic", lambda: t["now"])
+    q = AdmissionQueue(per_client=512, total=4096, adaptive=True,
+                       horizon_s=1.0, min_total=4)
+    # long idle: many window spans elapse with nothing queued
+    t["now"] += 30.0
+    for i in range(200):
+        q.submit_nowait("c", b"burst-%d" % i)   # must not shed
+    assert q.qsize() == 200
+    assert q.effective_total() == 4096   # EWMA still unseeded
+
+
 def test_admission_async_get_wakes_on_submit():
     async def go():
         q = AdmissionQueue()

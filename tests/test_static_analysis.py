@@ -305,6 +305,33 @@ def test_snapshot_adopt_fixture_findings():
     assert ok == [], [f.format() for f in ok]
 
 
+def test_quorum_math_fixture_findings():
+    """Inlined quorum arithmetic (2*n//3 [+1], n//3+1) is flagged
+    (membership plane: thresholds must track the epoch's active set);
+    helper-routed thresholds and innocent //3 capacity heuristics stay
+    clean."""
+    path = _fixture("quorum_math_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(
+        findings, "stale-quorum-math"
+    ) == _marked_lines(path, "stale-quorum-math"), \
+        [f.format() for f in findings]
+
+    ok = check_file(_fixture("quorum_math_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert [f for f in ok if f.rule == "stale-quorum-math"] == [], \
+        [f.format() for f in ok]
+
+
+def test_quorum_math_clean_project_wide():
+    """The whole tree routes through membership.quorum — the door the
+    rule closes stays closed (zero suppressions anywhere)."""
+    findings = run_paths([PKG], ALL_RULES, known_rules=RULE_NAMES,
+                         include_suppressed=True)
+    assert [f for f in findings if f.rule == "stale-quorum-math"] == [], \
+        [f.format() for f in findings if f.rule == "stale-quorum-math"]
+
+
 def test_snapshot_adopt_rule_passes_the_real_node():
     """node/node.py is where the rule earns its keep: _fast_forward
     calls load_snapshot and must reach the proof helpers through its
