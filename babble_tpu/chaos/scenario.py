@@ -124,6 +124,11 @@ class ScenarioResult:
     #: this run's own committed-key map (kept so a run can serve as a
     #: twin)
     committed_keys: Dict[int, dict] = field(default_factory=dict)
+    #: per-node flight-recorder dumps (ISSUE 11): captured at each
+    #: crash and at run end, so an invariant violation ships its own
+    #: last-N-transitions post-mortem instead of demanding a re-run.
+    #: Embedded in to_dict() only when the report has violations.
+    flight_dumps: Dict[int, list] = field(default_factory=dict)
     report: Optional[InvariantReport] = None
 
     def fingerprint(self) -> str:
@@ -174,6 +179,12 @@ class ScenarioResult:
             },
             "joined": sorted(self.joined),
             "invariants": self.report.to_dict() if self.report else None,
+            # post-mortem artifact: the per-node flight narratives ride
+            # the FAILURE (chaos run --json), never a green run's JSON
+            **({"flight": {str(k): v
+                           for k, v in sorted(self.flight_dumps.items())}}
+               if self.report is not None and not self.report.ok
+               and self.flight_dumps else {}),
         }
 
 
@@ -329,6 +340,11 @@ class ScenarioRunner:
                 conf.inactive_rounds = sc.inactive_rounds
             conf.kernel_class = self.kernel_class
             conf.byzantine = (sc.engine == "byzantine")
+            # flight stays ON (invariant violations attach its dumps);
+            # lineage OFF — nothing scrapes /debug/lineage in the
+            # in-memory runner, and its per-insert/ship records are
+            # pure overhead on the scenario hot loop
+            conf.lineage = False
             # positive interval with gossip=False means: syncs only mark
             # the pipeline dirty and the RUNNER decides when consensus
             # runs (a timer task would reintroduce wall-clock
@@ -424,6 +440,15 @@ class ScenarioRunner:
                 for action, node_idx in sched.get(step, ()):
                     h = handles[node_idx]
                     if action == "crash" and h.alive:
+                        # the crash IS the interesting transition: grab
+                        # the ring before the node object goes away (a
+                        # restart builds a fresh recorder).  APPEND —
+                        # a second crash of the same node must not
+                        # overwrite the first narrative
+                        result.flight_dumps[node_idx] = (
+                            result.flight_dumps.get(node_idx, [])
+                            + h.node.flight.dump()
+                        )
                         if durable:
                             # power-cut semantics: drop the file handles
                             # with NO clean-shutdown receipt and discard
@@ -631,6 +656,14 @@ class ScenarioRunner:
                     (e["epoch"], e["kind"], e["pub"], e["boundary"])
                     for e in getattr(h.node.core.hg, "membership_log", ())
                 ]
+                # APPEND to any crash-time capture: a restarted node's
+                # fresh recorder only holds post-restart records, and
+                # the pre-crash narrative is the part a post-mortem
+                # needs most
+                result.flight_dumps[h.idx] = (
+                    result.flight_dumps.get(h.idx, [])
+                    + h.node.flight.dump()
+                )
         finally:
             for h in handles:
                 if h.alive:

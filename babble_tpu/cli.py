@@ -196,6 +196,11 @@ async def _run_node(args) -> int:
         aot_dir=(
             "" if getattr(args, "no_aot_prewarm", False) else cache_dir
         ),
+        # attribution plane (ISSUE 11)
+        lineage=not getattr(args, "no_lineage", False),
+        flight=not getattr(args, "no_flight", False),
+        phase_probe=getattr(args, "phase_probe", False),
+        commit_slo=getattr(args, "commit_slo", 1000) / 1000.0,
     )
     conf.logger.setLevel(args.log_level.upper())
 
@@ -249,6 +254,13 @@ async def _run_node(args) -> int:
         )
     try:
         await node.run(gossip=True)
+    except Exception:
+        # crash post-mortem (ISSUE 11): an unhandled select-loop error
+        # dumps the flight recorder's last-N-transitions narrative next
+        # to the datadir before the process dies — the in-memory ring
+        # would otherwise die with it
+        _dump_flight_on_crash(node, args.datadir)
+        raise
     finally:
         if saver is not None:
             saver.cancel()
@@ -257,6 +269,19 @@ async def _run_node(args) -> int:
         await service.close()
         await node.shutdown()
     return 0
+
+
+def _dump_flight_on_crash(node, datadir: str) -> None:
+    import os
+
+    try:
+        path = os.path.join(datadir, "flight-crash.json")
+        with open(path, "w") as f:
+            json.dump({"stats": node.flight.stats(),
+                       "records": node.flight.dump()}, f, indent=1)
+        print(f"flight recorder dumped to {path}", file=sys.stderr)
+    except Exception as e:   # the dump must never mask the real crash
+        print(f"flight dump failed: {e}", file=sys.stderr)
 
 
 def _chaos_wrap(transport, args, key, peers):
@@ -438,6 +463,18 @@ def cmd_testnet(args) -> int:
             if args.once:
                 return 0
             time.sleep(args.interval)
+    if args.testnet_cmd in ("health", "trace"):
+        # the read-only observability sweeps share the fleet helpers:
+        # a same-host testnet is just a HostLayout of explicit
+        # host:service_port entries
+        from . import fleet as fl
+
+        layout = fl.HostLayout(
+            [ports.of(i)["service"] for i in range(args.n)]
+        )
+        if args.testnet_cmd == "health":
+            return _print_health(fl, layout, args.json)
+        return _print_trace(fl, layout, args.txid, args.json)
     if args.testnet_cmd == "bombard":
         if getattr(args, "clients", 1) > 1:
             # many-client harness: per-connection admission identities,
@@ -475,6 +512,42 @@ def cmd_testnet(args) -> int:
     raise SystemExit(f"unknown testnet subcommand {args.testnet_cmd}")
 
 
+def _print_health(fl, layout, as_json: bool) -> int:
+    """One /healthz sweep rendered as the fleet table (or JSON).  Exit
+    1 when any node is unreachable, degraded, or the fleet diverges —
+    a health verb that always exits 0 is a decoration."""
+    rows = fl.health_hosts(layout)
+    divergence = fl.health_divergence(rows)
+    if as_json:
+        print(json.dumps({"nodes": rows, "divergence": divergence},
+                         indent=1))
+    else:
+        print(fl.format_health(rows, divergence))
+    ok = (
+        all("health" in r for r in rows)
+        and all(r["health"].get("status") == "ok" for r in rows)
+        and not any(d["severity"] == "error" for d in divergence)
+    )
+    return 0 if ok else 1
+
+
+def _print_trace(fl, layout, txid: str, as_json: bool) -> int:
+    """Stitch one tx's cross-node lineage; exit 1 when nothing was
+    found (wrong txid, lineage disabled, or the ledgers rolled off)."""
+    from .obs.lineage import format_trace
+
+    st = fl.trace_tx(layout, txid)
+    if as_json:
+        print(json.dumps(st, indent=1))
+    else:
+        if st["errors"]:
+            for e in st["errors"]:
+                print(f"{e['host']}: {e['kind']}: {e['error']}",
+                      file=sys.stderr)
+        print(format_trace(st))
+    return 0 if st["timeline"] else 1
+
+
 def cmd_fleet(args) -> int:
     from . import fleet as fl
     from . import testnet as tn
@@ -489,6 +562,17 @@ def cmd_fleet(args) -> int:
         hosts, gossip_port=args.gossip_port, submit_port=args.submit_port,
         commit_port=args.commit_port, service_port=args.service_port,
     )
+    if (layout.explicit_service_ports()
+            and args.fleet_cmd not in ("watch", "scrape", "trace",
+                                       "health")):
+        # 'host:port' entries name SERVICE endpoints; conf/bombard
+        # would resolve every node to one shared default gossip/submit
+        # port on the same host and silently misroute
+        raise SystemExit(
+            "host:port entries are only valid for the read-only "
+            f"sweeps (watch/scrape/trace/health), not '{args.fleet_cmd}'"
+            " — list bare hosts and use the port flags instead"
+        )
     if args.fleet_cmd == "conf":
         dirs = fl.build_fleet_conf(
             __import__("os").path.join(args.dir, "conf"), layout
@@ -508,8 +592,39 @@ def cmd_fleet(args) -> int:
             fl.bombard_hosts(layout, args.rate, args.duration))
         print(f"submitted {sent} transactions")
         return 0
+    if args.fleet_cmd == "health":
+        return _print_health(fl, layout, args.json)
+    if args.fleet_cmd == "trace":
+        return _print_trace(fl, layout, args.txid, args.json)
     if args.fleet_cmd == "scrape":
         rows = fl.scrape_hosts(layout)
+        if getattr(args, "rollup", False):
+            rollup = fl.rollup_metrics(rows)
+            # digest-anchor divergence comes from /healthz (a hash
+            # cannot be a metric sample); best-effort — rollup output
+            # must not require every node to serve the health surface
+            try:
+                hrows = fl.health_hosts(layout)
+                # epoch divergence is already covered by the
+                # babble_epoch series check above
+                rollup["divergence"].extend(
+                    d for d in fl.health_divergence(hrows)
+                    if d["kind"] == "digest"
+                )
+            except Exception as e:
+                rollup["health_error"] = str(e)
+            if args.json:
+                print(json.dumps(rollup, indent=1))
+            else:
+                print(fl.format_rollup(rollup))
+            # a diverged fleet must fail the sweep the same way fleet
+            # health would — CI scripted on this exit code must not
+            # see green over a split committed history
+            diverged = any(
+                d.get("severity") == "error"
+                for d in rollup["divergence"]
+            )
+            return 0 if not rollup["unparsed"] and not diverged else 1
         if getattr(args, "spans", False):
             # merge the span sweep into the metrics rows; span output is
             # structured (trees), so this mode is always JSON.  A
@@ -744,6 +859,21 @@ def main(argv=None) -> int:
     rn.add_argument("--wal_fsync", default="batch",
                     help="WAL fsync policy: always | batch(n,ms) | off "
                          "(default batch = 64 appends / 50 ms)")
+    rn.add_argument("--no_lineage", action="store_true",
+                    help="disable commit-lineage tracing (per-tx/per-"
+                         "event lifecycle ledgers behind /debug/lineage "
+                         "and `fleet trace`)")
+    rn.add_argument("--no_flight", action="store_true",
+                    help="disable the flight recorder (state-transition "
+                         "ring behind /debug/flight + crash dumps)")
+    rn.add_argument("--phase_probe", action="store_true",
+                    help="dispatch the fused latency flush as three "
+                         "separately-timed sub-programs (ingest/fame/"
+                         "order wall histograms; bit-identical results, "
+                         "one host sync per phase — profiling posture)")
+    rn.add_argument("--commit_slo", type=int, default=1000,
+                    help="commit-latency SLO in ms for the /healthz "
+                         "burn gauge")
     rn.add_argument("--chaos_plan", default="",
                     help="scenario/fault-plan JSON: wrap the transport "
                          "in a seeded FaultyTransport (chaos testing)")
@@ -781,6 +911,8 @@ def main(argv=None) -> int:
     for name, hlp in (("conf", "write node datadirs + peers.json"),
                       ("run", "launch nodes + dummy apps"),
                       ("watch", "poll fleet /Stats"),
+                      ("health", "one /healthz sweep + divergence table"),
+                      ("trace", "stitch a tx's cross-node lineage"),
                       ("bombard", "flood random transactions")):
         sp = tsub.add_parser(name, help=hlp)
         sp.add_argument("--n", type=int, default=4)
@@ -788,6 +920,12 @@ def main(argv=None) -> int:
         sp.add_argument("--base_port", type=int, default=12000)
         if name == "conf":
             sp.add_argument("--overwrite", action="store_true")
+        if name == "health":
+            sp.add_argument("--json", action="store_true")
+        if name == "trace":
+            sp.add_argument("txid", help="sha256 hex of the exact "
+                                         "submitted tx bytes")
+            sp.add_argument("--json", action="store_true")
         if name == "run":
             sp.add_argument("--heartbeat", type=int, default=10, help="ms")
             sp.add_argument("--no_clients", action="store_true")
@@ -814,11 +952,16 @@ def main(argv=None) -> int:
         ("conf", "node datadirs + peers.json + ssh deploy scripts"),
         ("watch", "poll every host's /Stats"),
         ("scrape", "sweep every host's /metrics (Prometheus text)"),
+        ("health", "sweep every host's /healthz into one fleet table "
+                   "flagging epoch/lcr/digest divergence"),
+        ("trace", "scrape + stitch one tx's cross-node commit lineage"),
         ("bombard", "flood transactions across the hosts"),
     ):
         sp = fsub.add_parser(name, help=hlp)
         sp.add_argument("--hosts", required=True,
-                        help="file with one routable host address per line")
+                        help="file with one routable host address per "
+                             "line ('host' or 'host:service_port' — the "
+                             "latter for same-host fleets)")
         sp.add_argument("--dir", default="fleet-data")
         sp.add_argument("--gossip_port", type=int, default=1337)
         sp.add_argument("--submit_port", type=int, default=1338)
@@ -835,6 +978,17 @@ def main(argv=None) -> int:
                             help="also fetch each host's /debug/spans "
                                  "(loopback-gated hosts report kind="
                                  "'gated'); implies JSON output")
+            sp.add_argument("--rollup", action="store_true",
+                            help="aggregate per-node series into fleet "
+                                 "sums/maxes with a divergence section "
+                                 "(disagreeing babble_epoch / digest "
+                                 "anchors render as warning rows)")
+        if name == "health":
+            sp.add_argument("--json", action="store_true")
+        if name == "trace":
+            sp.add_argument("txid", help="sha256 hex of the exact "
+                                         "submitted tx bytes")
+            sp.add_argument("--json", action="store_true")
         if name == "bombard":
             sp.add_argument("--rate", type=float, default=50.0, help="tx/s")
             sp.add_argument("--duration", type=float, default=10.0)

@@ -82,6 +82,16 @@ class TpuHashgraph:
     kernel_class = "throughput"
     last_kernel_class: Optional[str] = None
     flush_fallbacks = 0
+    #: attribution plane (ISSUE 11): per-flush HBM bytes-touched
+    #: estimate ({"ingest","fame","order","total"}, ops/flush.py) and
+    #: the per-phase wall timings of the last probed flush.  Read by
+    #: the node after each consensus run; None when nothing flushed.
+    last_flush_bytes: Optional[dict] = None
+    #: phase probe (Config.phase_probe): dispatch the fused latency
+    #: flush as three separately-timed sub-programs instead of one
+    #: launch — bit-identical results, one host sync per phase
+    phase_probe = False
+    _last_phase_timings: Optional[dict] = None
     inactive_rounds: Optional[int] = None
     _evicted_creators_cache = 0
     # membership plane (ISSUE 9) class-level defaults: engines without
@@ -180,6 +190,11 @@ class TpuHashgraph:
         #: throughput surface for run-to-completion
         self.flush_fallbacks = 0
         self._fallback_counted = False   # per-flush dedup for the gauge
+        # attribution plane (ISSUE 11): per-flush traffic estimate +
+        # the phase-probe timings of the last latency flush
+        self.last_flush_bytes: Optional[dict] = None
+        self._last_phase_timings: Optional[dict] = None
+        self.phase_probe = False
 
         # Membership plane (ISSUE 9): the validator set is consensus
         # state.  A committed, subject-signed transition tx schedules a
@@ -522,7 +537,16 @@ class TpuHashgraph:
 
         Both paths are bit-identical on the same flush sequence
         (tests/test_flush.py parity suite); ``last_kernel_class``
-        records the pick for the node's flush histograms."""
+        records the pick for the node's flush histograms.
+
+        Profiling hooks (ISSUE 11 (c)): each dispatch runs inside a
+        ``jax.profiler.TraceAnnotation`` region (nanosecond-cheap when
+        no trace is active, phase-attributed in a /debug/trace
+        capture), and ``last_flush_bytes`` carries the flush's
+        HBM-traffic estimate for the node's bytes histograms."""
+        from jax.profiler import TraceAnnotation
+
+        k_pending = len(self.dag.pending)
         t0 = time.perf_counter()
         if self._latency_ok():
             # _flush_live overwrites this with "throughput" when it
@@ -530,15 +554,26 @@ class TpuHashgraph:
             # repair, W undershoot) — the flush histogram must not
             # book multi-second full-table passes under "latency"
             self.last_kernel_class = "latency"
-            events = self._flush_live()
-            return events, {"flush_s": time.perf_counter() - t0}
+            with TraceAnnotation("babble_flush_latency"):
+                events = self._flush_live()
+            out = {"flush_s": time.perf_counter() - t0}
+            if self._last_phase_timings:
+                out.update(self._last_phase_timings)
+            return events, out
         self.last_kernel_class = "throughput"
-        self.divide_rounds()
+        with TraceAnnotation("babble_flush_ingest"):
+            self.divide_rounds()
         t1 = time.perf_counter()
-        self.decide_fame()
+        with TraceAnnotation("babble_flush_fame"):
+            self.decide_fame()
         t2 = time.perf_counter()
-        events = self.find_order()
+        with TraceAnnotation("babble_flush_order"):
+            events = self.find_order()
         t3 = time.perf_counter()
+        if type(self).KERNEL_SPLIT and k_pending:
+            self.last_flush_bytes = flush_ops.throughput_bytes_estimate(
+                self.cfg, k_pending
+            )
         return events, {
             "divide_rounds_s": t1 - t0,
             "decide_fame_s": t2 - t1,
@@ -609,10 +644,18 @@ class TpuHashgraph:
         prewarmed, jit otherwise), refresh host mirrors, commit."""
         self._check_narrow_seq_range()
         w = self._latency_w
+        k_pending = len(self.dag.pending)
         batch, _ = self.build_batch()
         key = (w, self.finality_gate, batch.sp.shape[0]) + batch.sched.shape
         exe = self._aot.get(key)
-        if exe is not None:
+        self._last_phase_timings = None
+        if self.phase_probe:
+            # three timed dispatches, bit-identical to the fused launch
+            # (same impls, same order) — the per-phase wall meter
+            self.state, self._last_phase_timings = flush_ops.probed_flush(
+                self.cfg, w, self.finality_gate, self.state, batch
+            )
+        elif exe is not None:
             self.state = exe(self.state, batch)
         else:
             self.state = flush_ops.live_flush(
@@ -625,6 +668,9 @@ class TpuHashgraph:
 
                 self._aot_recorded.add(key)
                 aot_ops.record_shape(self._aot_dir, self.cfg, key)
+        self.last_flush_bytes = flush_ops.flush_bytes_estimate(
+            self.cfg, w, k_pending
+        )
         self._view = {}
         lcr_pre = self._lcr_cache
         self._max_round_cache = int(self.state.max_round)
@@ -632,6 +678,7 @@ class TpuHashgraph:
             # headroom check should make this unreachable; degrade to the
             # repairing throughput path rather than trust clipped rounds
             self.last_kernel_class = "throughput"
+            self._book_fallback_bytes()
             self._repair_rounds()
             self.decide_fame()
             return self.find_order()
@@ -656,9 +703,23 @@ class TpuHashgraph:
             # finish with the full-table phases instead of deferring to
             # a flush that may never come.
             self.last_kernel_class = "throughput"
+            self._book_fallback_bytes()
             self.decide_fame()
             return self.find_order()
         return self._collect_ordered()
+
+    def _book_fallback_bytes(self) -> None:
+        """A latency flush degrading to the full-table phases touches
+        the windowed bytes AND the r_cap tables: without this, the
+        expensive outlier flushes — exactly what ROADMAP item 4's
+        meter must attribute — would be booked with the cheap windowed
+        model.  The batch already ingested incrementally, so the
+        throughput term carries k=0."""
+        lat = self.last_flush_bytes or {}
+        thr = flush_ops.throughput_bytes_estimate(self.cfg, 0)
+        self.last_flush_bytes = {
+            k: lat.get(k, 0) + thr[k] for k in thr
+        }
 
     def _head_round_min_host(self) -> int:
         """Host mirror of ops.state.head_round_min_math (same chain

@@ -29,7 +29,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .crypto.keys import PemKeyFile, generate_key
 from .net.peers import JSONPeers, Peer
-from .testnet import HTTPException, fetch_metrics, fetch_spans, fetch_stats
+from .testnet import (
+    HTTPException,
+    fetch_healthz,
+    fetch_lineage,
+    fetch_metrics,
+    fetch_spans,
+    fetch_stats,
+)
 
 GOSSIP_PORT = 1337   # the reference's conventional ports
 SUBMIT_PORT = 1338   # (terraform/scripts/remote-run.sh:12-19)
@@ -47,8 +54,28 @@ class HostLayout:
     commit_port: int = COMMIT_PORT
     service_port: int = SERVICE_PORT
 
+    def explicit_service_ports(self) -> bool:
+        """True when any host entry carries an explicit service port —
+        valid only for the read-only sweeps (watch/scrape/trace/
+        health); the write verbs (conf/bombard) would silently target
+        every node at one shared default port."""
+        return any(":" in h for h in self.hosts)
+
     def of(self, i: int) -> Dict[str, str]:
         h = self.hosts[i]
+        if ":" in h:
+            # an explicit "host:port" entry names the node's SERVICE
+            # endpoint directly — the same-host-testnet case, where
+            # every node shares one address but not one port (the
+            # read-only sweeps: watch/scrape/trace/health).  The
+            # gossip/submit/commit ports keep the layout defaults.
+            host, _, svc = h.rpartition(":")
+            return {
+                "gossip": f"{host}:{self.gossip_port}",
+                "submit": f"{host}:{self.submit_port}",
+                "commit": f"{host}:{self.commit_port}",
+                "service": f"{host}:{svc}",
+            }
         return {
             "gossip": f"{h}:{self.gossip_port}",
             "submit": f"{h}:{self.submit_port}",
@@ -275,6 +302,233 @@ def scrape_spans(layout: HostLayout,
         else:
             rows.append({"host": addr, "error": err, "kind": kind})
     return rows
+
+
+# ----------------------------------------------------------------------
+# consensus-health plane (ISSUE 11 (d)): /healthz sweep + divergence
+
+
+def health_hosts(layout: HostLayout,
+                 timeout: float = 3.0) -> List[Dict[str, object]]:
+    """Fleet-wide /healthz sweep.  Rows are ``{"host", "health"}`` on
+    success, ``{"host", "error", "kind"}`` with the :func:`_sweep`
+    classification on failure."""
+    rows = []
+    for _i, addr, health, kind, err in _sweep(
+            layout, lambda a: fetch_healthz(a, timeout=timeout)):
+        if kind is None:
+            rows.append({"host": addr, "health": health})
+        else:
+            rows.append({"host": addr, "error": err, "kind": kind})
+    return rows
+
+
+def health_divergence(rows: List[Dict[str, object]],
+                      lcr_lag_warn: int = 16) -> List[Dict[str, object]]:
+    """Cross-node divergence verdicts over a health sweep.  Hard flags:
+
+    - ``epoch``: honest nodes must agree on the applied epoch ledger —
+      any spread is a membership-plane split;
+    - ``digest``: two nodes at the SAME commit position reporting
+      different rolling digests hold different committed histories —
+      the loudest possible alarm;
+
+    and a soft flag ``lcr_lag`` for nodes more than ``lcr_lag_warn``
+    decided rounds behind the fleet maximum (slow or stalled, not
+    necessarily split)."""
+    ok = [(r["host"], r["health"]) for r in rows if "health" in r]
+    out: List[Dict[str, object]] = []
+    if not ok:
+        return out
+    epochs = {h: hl.get("epoch", 0) for h, hl in ok}
+    if len(set(epochs.values())) > 1:
+        out.append({"kind": "epoch", "severity": "error",
+                    "values": epochs})
+    by_pos: Dict[int, Dict[str, str]] = {}
+    for h, hl in ok:
+        by_pos.setdefault(int(hl.get("commit_length", 0)), {})[h] = (
+            hl.get("digest", "")
+        )
+    for pos, digests in sorted(by_pos.items()):
+        if len(set(digests.values())) > 1:
+            out.append({"kind": "digest", "severity": "error",
+                        "position": pos, "values": digests})
+    lcrs = {h: int(hl.get("lcr", -1)) for h, hl in ok}
+    top = max(lcrs.values())
+    lagging = {h: v for h, v in lcrs.items() if top - v > lcr_lag_warn}
+    if lagging:
+        out.append({"kind": "lcr_lag", "severity": "warning",
+                    "fleet_max": top, "values": lagging})
+    return out
+
+
+def format_health(rows: List[Dict[str, object]],
+                  divergence: List[Dict[str, object]]) -> str:
+    """One fleet table + the divergence section, loudly."""
+    cols = ("host", "status", "epoch", "lcr", "commits", "rate",
+            "margin", "burn", "blocked", "behind")
+    table = []
+    for r in rows:
+        if "health" not in r:
+            table.append((r["host"], f"<{r['kind']}: {r['error']}>",) +
+                         ("",) * (len(cols) - 2))
+            continue
+        h = r["health"]
+        table.append((
+            r["host"], h.get("status", "?"), str(h.get("epoch", "?")),
+            str(h.get("lcr", "?")), str(h.get("commit_length", "?")),
+            f"{h.get('round_advance_rate', 0):.2f}",
+            str(h.get("quorum_margin", "?")),
+            f"{h.get('commit_slo_burn', 0):.2f}",
+            ",".join(h.get("reasons", [])) or "-",
+            ",".join(str(c) for c in h.get("behind_horizon", [])) or "-",
+        ))
+    widths = [max(len(cols[i]), *(len(row[i]) for row in table))
+              for i in range(len(cols))] if table else [len(c) for c in cols]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    lines.append("-" * len(lines[0]))
+    for row in table:
+        lines.append("  ".join(v.ljust(w) for v, w in zip(row, widths)))
+    if divergence:
+        lines.append("")
+        lines.append("!!! FLEET DIVERGENCE !!!")
+        for d in divergence:
+            lines.append(f"  [{d['severity']}] {d['kind']}: " + ", ".join(
+                f"{h}={v}" for h, v in sorted(d["values"].items())
+            ) + (f" (position {d['position']})" if "position" in d else "")
+              + (f" (fleet max {d['fleet_max']})" if "fleet_max" in d
+                 else ""))
+    else:
+        lines.append("no cross-node divergence detected")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+# commit-lineage tracing (ISSUE 11 (a)): fleet-stitched tx timelines
+
+
+def trace_tx(layout: HostLayout, txid: str,
+             timeout: float = 3.0) -> dict:
+    """Scrape every node's /debug/lineage for ``txid`` and stitch one
+    cross-node timeline (obs/lineage.stitch).  Unreachable or gated
+    hosts are reported in ``"errors"`` — a partial trace beats none."""
+    from .obs.lineage import stitch
+
+    dumps = []
+    errors = []
+    for _i, addr, dump, kind, err in _sweep(
+            layout, lambda a: fetch_lineage(a, txid, timeout=timeout)):
+        if kind is None:
+            dump["node"] = addr
+            dumps.append(dump)
+        else:
+            errors.append({"host": addr, "kind": kind, "error": err})
+    st = stitch(dumps)
+    st["errors"] = errors
+    return st
+
+
+# ----------------------------------------------------------------------
+# fleet scrape rollup (ISSUE 11 satellite): per-node series aggregated
+# into fleet-wide sums/maxes with a loud divergence section
+
+
+def parse_exposition(text: str) -> Tuple[Dict[str, str], Dict[str, float]]:
+    """Parse one Prometheus text blob into ``(types, samples)`` where
+    ``types`` maps family name -> kind and ``samples`` maps the full
+    sample key (name + label string) -> value."""
+    types: Dict[str, str] = {}
+    samples: Dict[str, float] = {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if ln.startswith("#"):
+            continue
+        key, _, val = ln.rpartition(" ")
+        try:
+            samples[key] = float(val)
+        except ValueError:
+            continue
+    return types, samples
+
+
+def rollup_metrics(rows: List[Dict[str, str]],
+                   expect_same: Tuple[str, ...] = ("babble_epoch",),
+                   ) -> dict:
+    """Aggregate a :func:`scrape_hosts` sweep into fleet-wide numbers.
+
+    Counters (and histogram buckets/sums/counts, which are just
+    counter samples) SUM across nodes; gauges report sum AND max.
+    Series named in ``expect_same`` are consensus state every honest
+    node must agree on — disagreement lands in ``divergence`` as a
+    warning row with per-host values, never averaged away silently."""
+    types: Dict[str, str] = {}
+    per_host: Dict[str, Dict[str, float]] = {}
+    for row in rows:
+        if "metrics" not in row:
+            continue
+        t, s = parse_exposition(row["metrics"])
+        types.update(t)
+        per_host[row["host"]] = s
+    agg: Dict[str, Dict[str, float]] = {}
+    for host, samples in per_host.items():
+        for key, val in samples.items():
+            a = agg.setdefault(key, {"sum": 0.0, "max": float("-inf"),
+                                     "min": float("inf"), "nodes": 0})
+            if val == val:   # NaN-safe: dead gauge callbacks stay out
+                a["sum"] += val
+                a["max"] = max(a["max"], val)
+                a["min"] = min(a["min"], val)
+                a["nodes"] += 1
+    divergence = []
+    for name in expect_same:
+        values = {
+            host: samples[name]
+            for host, samples in per_host.items() if name in samples
+        }
+        if len(set(values.values())) > 1:
+            # expect-same series ARE consensus state (babble_epoch): a
+            # split is an error, same as health_divergence's verdict —
+            # the rollup exit code must not read green over it
+            divergence.append({"kind": "series", "series": name,
+                               "severity": "error", "values": values})
+    return {"types": types, "series": agg, "divergence": divergence,
+            "hosts": sorted(per_host),
+            "unparsed": [r["host"] for r in rows if "metrics" not in r]}
+
+
+def format_rollup(rollup: dict) -> str:
+    """Aggregated exposition-style text: counters as fleet sums, gauges
+    as sum+max, divergence section first (and loud)."""
+    lines = []
+    if rollup["divergence"]:
+        lines.append("!!! FLEET DIVERGENCE !!!")
+        for d in rollup["divergence"]:
+            label = d.get("series") or d.get("kind")
+            lines.append(f"  [{d['severity']}] {label}: " + ", ".join(
+                f"{h}={v}" for h, v in sorted(d["values"].items())
+            ))
+        lines.append("")
+    lines.append(f"# fleet rollup over {len(rollup['hosts'])} hosts"
+                 + (f" ({len(rollup['unparsed'])} missing)"
+                    if rollup["unparsed"] else ""))
+    types = rollup["types"]
+    for key in sorted(rollup["series"]):
+        a = rollup["series"][key]
+        family = key.split("{", 1)[0]
+        kind = types.get(family)
+        if kind is None and family.endswith(("_bucket", "_sum", "_count")):
+            kind = types.get(family.rsplit("_", 1)[0], "counter")
+        if kind == "gauge":
+            lines.append(f"{key} sum={a['sum']:g} max={a['max']:g}")
+        else:
+            lines.append(f"{key} {a['sum']:g}")
+    return "\n".join(lines)
 
 
 async def bombard_hosts(

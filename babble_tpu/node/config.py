@@ -144,6 +144,30 @@ class Config:
     # boundary admits it.  None = the peers list IS the validator set
     # (the static pre-membership behavior).
     bootstrap_peers: list | None = None
+    # ---- attribution plane (ISSUE 11) ----
+    # Commit-lineage tracing: a bounded per-tx/per-event lifecycle
+    # ledger (obs/lineage.py) keyed on the hashes consensus already
+    # computes — served loopback-gated at /debug/lineage?tx= and
+    # stitched fleet-wide by `fleet trace <txid>`.  Zero wire or
+    # consensus changes; False turns every hook into a no-op (the
+    # bench's tracing-overhead A/B switch).
+    lineage: bool = True
+    # Flight recorder: a bounded ring of structured state-transition
+    # records (obs/flight.py) — epoch applies, eviction horizon
+    # advances, FF attempts/rejects, probe arm/resolve, admission shed
+    # episodes, kernel fallbacks — dumped at /debug/flight, on node
+    # crash, and attached to chaos invariant violations.
+    flight: bool = True
+    # Commit-latency SLO (seconds) for the /healthz burn gauge: the
+    # fraction of recent commit batch deliveries slower than this.
+    commit_slo: float = 1.0
+    # Phase probe (ROADMAP item 4 meter): dispatch the fused latency
+    # flush as three separately-timed sub-programs (ingest / fame /
+    # order) so babble_consensus_phase_seconds splits the fused
+    # kernel's wall time per phase.  Bit-identical results (the same
+    # impl functions run in the same order); costs one host sync per
+    # phase, so it is a profiling posture, not the default.
+    phase_probe: bool = False
     # Durability plane (babble_tpu/wal): "" disables the write-ahead
     # log (the pre-WAL behavior — restarts may re-mint published seqs
     # unless a fresh checkpoint exists).  With a directory set, every

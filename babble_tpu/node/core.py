@@ -71,9 +71,17 @@ class Core:
         wal: Optional[WriteAheadLog] = None,
         kernel_class: str = "auto",
         inactive_rounds: Optional[int] = 32,
+        lineage=None,
+        phase_probe: bool = False,
     ):
         self.id = core_id
         self.kernel_class = kernel_class
+        # attribution plane (ISSUE 11): the owning node's commit-lineage
+        # recorder.  Hooks live at the two places only the Core can see
+        # — the mint (tx -> event hash join pivot) and the peer insert.
+        # None (or a disabled recorder) makes every hook a no-op.
+        self.lineage = lineage
+        self.phase_probe = phase_probe
         self.key = key
         self.pub_hex = key.pub_hex
         self.participants = participants
@@ -448,6 +456,7 @@ class Core:
                 and type(self.hg).KERNEL_SPLIT):
             self.hg.finality_gate = True
             self.hg.kernel_class = self.kernel_class
+            self.hg.phase_probe = self.phase_probe
 
     def _rebind_engine_registry(self) -> None:
         """Point the current engine's instruments at this core's
@@ -766,6 +775,9 @@ class Core:
         self.hg.insert_event(event)
         self.head = event.hex()
         self.seq = event.index
+        if self.lineage is not None:
+            # the mint record is the tx -> event hash-join pivot
+            self.lineage.note_mint(event.hex(), event.transactions)
 
     def insert_event(self, event: Event) -> None:
         self.hg.insert_event(event)
@@ -888,6 +900,9 @@ class Core:
                     self.insert_event(ev)
                     self._wal_append(ev)
                     self._adopt_own_event(ev)
+                    if self.lineage is not None:
+                        self.lineage.note_event(ev.hex(), "insert",
+                                                index=ev.index)
                     self._creator_backoff.pop(cid, None)  # progress
                 except ValueError as e:   # includes ForkBudgetError
                     from ..ops.forks import ParentUnknownError
@@ -909,6 +924,9 @@ class Core:
                 self.insert_event(ev)
                 self._wal_append(ev)
                 self._adopt_own_event(ev)
+                if self.lineage is not None:
+                    self.lineage.note_event(ev.hex(), "insert",
+                                            index=ev.index)
         self._retry_wal_orphans()
         if (other_head not in self.hg.dag.slot_of
                 and (self.byzantine or other_head)):

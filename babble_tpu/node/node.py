@@ -55,7 +55,14 @@ from ..net.commands import (
 )
 from ..net.peers import Peer, canonical_ids
 from ..net.transport import Transport, TransportError
-from ..obs import SIZE_BUCKETS, LoopLagProbe, Registry, SpanTracer
+from ..obs import (
+    SIZE_BUCKETS,
+    FlightRecorder,
+    LineageRecorder,
+    LoopLagProbe,
+    Registry,
+    SpanTracer,
+)
 from .config import Config
 from .core import Core
 from .peer_selector import RandomPeerSelector
@@ -132,6 +139,15 @@ class Node:
         # the wire-level series land on the same /metrics page.
         self.registry = registry if registry is not None else Registry()
         self.tracer = SpanTracer()
+        # Attribution plane (ISSUE 11): the lineage recorder holds the
+        # per-tx/per-event lifecycle ledgers behind /debug/lineage and
+        # `fleet trace`; the flight recorder the state-transition ring
+        # behind /debug/flight and the chaos post-mortems.  Both are
+        # NODE-owned (like the tracer): a fast-forward engine swap or
+        # checkpoint restart replaces self.core.hg, never these —
+        # tests/test_lineage.py pins that records survive the swap.
+        self.lineage = LineageRecorder(enabled=conf.lineage)
+        self.flight = FlightRecorder(enabled=conf.flight)
 
         # Membership plane: the epoch-0 validator set may be a strict
         # subset of the gossip address book — a joiner knows the
@@ -194,7 +210,12 @@ class Node:
             registry=self.registry,
             kernel_class=conf.kernel_class,
             inactive_rounds=conf.inactive_rounds,
+            lineage=self.lineage,
+            phase_probe=conf.phase_probe,
         )
+        if self.core.probing:
+            self.flight.note("probe_armed",
+                             quorum=self.core._probe_quorum)
         # AOT compile cache (ops/aot.py): pre-compile the recorded
         # live-flush shapes at boot — against the persistent XLA cache a
         # restart reaches its first flush in seconds — and surface the
@@ -396,6 +417,52 @@ class Node:
         ).set_function(
             lambda: 1 if getattr(self.core.hg, "pending_membership", None)
             else 0)
+        # attribution plane (ISSUE 11): per-flush HBM-traffic estimates
+        # (ops/flush.flush_bytes_estimate — item 4's before/after meter)
+        # and the consensus-health gauges behind /healthz
+        self._m_flush_bytes = m.histogram(
+            "babble_flush_bytes_estimate",
+            "estimated bytes touched per consensus flush (dominant-"
+            "tensor model over the live DagState shapes)",
+            buckets=SIZE_BUCKETS)
+        self._m_flush_bytes_phase = m.counter(
+            "babble_flush_bytes_estimate_total",
+            "cumulative estimated flush bytes, by pipeline phase",
+            labelnames=("phase",))
+        for ph in ("ingest", "fame", "order"):
+            self._m_flush_bytes_phase.labels(ph)
+        #: health mirror: sampled on the consensus path (where the host
+        #: views are already warm), read by gauges and /healthz with no
+        #: device sync at scrape time
+        self._health: Dict[str, object] = {
+            "lcr_samples": [],       # (monotonic, lcr) ring, cap 32
+            "creator_lags": {},      # cid -> decided rounds behind lcr
+            "commit_lat": [],        # recent commit-batch latencies
+        }
+        m.gauge(
+            "babble_round_advance_rate",
+            "decided rounds per second over the recent consensus runs "
+            "(0 while ordering is stalled)",
+        ).set_function(self._round_advance_rate)
+        m.gauge(
+            "babble_quorum_margin",
+            "active validators beyond the witness supermajority — how "
+            "many more can fail before rounds stop deciding",
+        ).set_function(self._quorum_margin)
+        m.gauge(
+            "babble_commit_slo_burn",
+            "fraction of recent commit batch deliveries slower than "
+            "Config.commit_slo",
+        ).set_function(self._commit_slo_burn)
+        self._m_creator_lag = m.gauge(
+            "babble_creator_lag_rounds",
+            "per-creator chain-head lag behind the last consensus "
+            "round (sampled after each consensus run)",
+            labelnames=("creator",))
+        #: flight-recorder change detection (kernel fallbacks, eviction
+        #: horizons) — previous values noted on the consensus path
+        self._flight_seen = {"fallbacks": 0, "horizons": {},
+                             "kernel": None}
         self._loop_probe = LoopLagProbe(m)
         # transport-level series (bytes in/out, pool reuse) land on the
         # same /metrics page when the transport supports instrumentation
@@ -408,6 +475,11 @@ class Node:
         proxy_instrument = getattr(proxy, "instrument", None)
         if proxy_instrument is not None:
             proxy_instrument(m)
+        # ... and the ingress-side lineage/flight hooks (submit/admit/
+        # shed records) bind the same late way
+        bind_obs = getattr(proxy, "bind_observability", None)
+        if bind_obs is not None:
+            bind_obs(self.lineage, self.flight)
         # a checkpoint-restored engine may carry epochs this node's
         # boot peer list predates: reconcile the ledger now
         self._sync_membership()
@@ -440,6 +512,143 @@ class Node:
         return out
 
     # ------------------------------------------------------------------
+    # consensus-health plane (ISSUE 11 (d))
+
+    #: newest consensus run older than this = the node is not running
+    #: consensus at all — /healthz must read stalled, not replay its
+    #: last healthy rate forever
+    HEALTH_STALL_AFTER_S = 30.0
+
+    def _round_advance_rate(self) -> float:
+        """Decided rounds per second, measured to NOW: a node whose
+        consensus stopped running (full partition, dead fleet) decays
+        toward zero instead of freezing at its pre-outage rate —
+        samples only accrue while consensus runs, so the last sample's
+        age is part of the denominator."""
+        samples = self._health["lcr_samples"]
+        if len(samples) < 2:
+            return 0.0
+        (t0, l0), (_t1, l1) = samples[0], samples[-1]
+        dt = time.monotonic() - t0
+        return (max(l1 - l0, 0) / dt) if dt > 0 else 0.0
+
+    def _quorum_margin(self) -> int:
+        from ..membership.quorum import supermajority
+
+        active = self.core._active_count()
+        return active - supermajority(active)
+
+    def _commit_slo_burn(self) -> float:
+        lat = self._health["commit_lat"]
+        if not lat:
+            return 0.0
+        slo = self.conf.commit_slo
+        return sum(1 for v in lat if v > slo) / len(lat)
+
+    def _sample_health(self) -> None:
+        """Update the health mirror after a consensus run.  Reads only
+        host-side structures (and the engine's post-flush cached round
+        view when present), so neither this nor any gauge scrape ever
+        syncs the device."""
+        import time as _time
+
+        snap = self.core.stats_snapshot()
+        lcr = int(snap.get("last_consensus_round", -1))
+        samples = self._health["lcr_samples"]
+        samples.append((_time.monotonic(), lcr))
+        del samples[:-32]
+        hg = self.core.hg
+        rnd = getattr(hg, "_view", {}).get("round")
+        chains = getattr(getattr(hg, "dag", None), "chains", None)
+        if rnd is None or chains is None or lcr < 0:
+            return
+        base = hg.dag.slot_base
+        lags: Dict[int, int] = {}
+        for cid, chain in enumerate(chains):
+            if len(chain) == 0:
+                continue   # never minted (a declared joiner): no lag yet
+            if not chain.window:
+                # tail evicted for inactivity: lag is "the whole decided
+                # history since its horizon" — report the eviction lag
+                lags[cid] = lcr + 1
+                continue
+            try:
+                head_round = int(rnd[chain[-1] - base])
+            except (IndexError, ValueError):
+                continue
+            lags[cid] = max(lcr - head_round, 0)
+        self._health["creator_lags"] = lags
+        for cid, lag in lags.items():
+            self._m_creator_lag.labels(str(cid)).set(lag)
+
+    def healthz(self) -> Dict[str, object]:
+        """The structured consensus-health verdict behind GET /healthz
+        (and `fleet health`).  Everything here is a host mirror — safe
+        to serve while a worker thread drives the device pipeline."""
+        core = self.core
+        hg = core.hg
+        snap = core.stats_snapshot()
+        reasons: List[str] = []
+        if core._observer:
+            reasons.append("observer")
+        if core._retired_self:
+            reasons.append("retired")
+        if core.probing:
+            reasons.append("seq_probe")
+        if (not reasons) and core.seq + 1 < core.min_next_seq:
+            reasons.append("below_mint_floor")
+        pending = getattr(hg, "pending_membership", None)
+        lags = dict(self._health["creator_lags"])
+        # inactive_rounds None/0 = per-creator eviction DISABLED (the
+        # PR-8 convention): there is no horizon, so nobody is "behind"
+        # it — reporting one would tell the operator a window was
+        # evicted that never will be
+        inact = self.conf.inactive_rounds
+        behind = sorted(
+            cid for cid, lag in lags.items() if lag > inact
+        ) if inact else []
+        rate = self._round_advance_rate()
+        samples = self._health["lcr_samples"]
+        idle_s = (time.monotonic() - samples[-1][0]) if samples else 0.0
+        stalled = (
+            (rate == 0.0 or idle_s > self.HEALTH_STALL_AFTER_S)
+            and int(snap.get("undetermined_events", 0)) > 0
+            and len(samples) >= 2
+        )
+        status = "ok"
+        if reasons or stalled:
+            status = "degraded"
+        dg = getattr(hg, "_digest", None)
+        return {
+            "status": status,
+            "id": core.id,
+            "minting_blocked": bool(reasons),
+            "reasons": reasons,
+            "probe_armed": bool(core.probing),
+            "epoch_pending": bool(pending),
+            "epoch": int(snap.get("epoch", 0)),
+            "lcr": int(snap.get("last_consensus_round", -1)),
+            "commit_length": int(getattr(hg, "commit_length", 0)),
+            "digest": getattr(hg, "commit_digest", ""),
+            "digest_anchor": (
+                {"pos": dg.anchor_pos, "hash": dg.anchor}
+                if dg is not None else None
+            ),
+            "round_advance_rate": round(rate, 4),
+            "consensus_idle_s": round(idle_s, 2),
+            "stalled": stalled,
+            "quorum_margin": self._quorum_margin(),
+            "active_n": core._active_count(),
+            "commit_slo_s": self.conf.commit_slo,
+            "commit_slo_burn": round(self._commit_slo_burn(), 4),
+            "creator_lags": {str(k): v for k, v in sorted(lags.items())},
+            "behind_horizon": behind,
+            "undetermined": int(snap.get("undetermined_events", 0)),
+            "evicted_creators": int(snap.get("evicted_creators", 0)),
+            "transaction_pool": len(self.transaction_pool),
+        }
+
+    # ------------------------------------------------------------------
 
     def _sync_membership(self) -> None:
         """Reconcile the node's address maps, gossip selector and
@@ -453,6 +662,9 @@ class Node:
             self._membership_seen += 1
             self._m_transitions.inc()
             pub, addr, kind = entry["pub"], entry["addr"], entry["kind"]
+            self.flight.note("epoch_apply", epoch=entry["epoch"],
+                             op=kind, pub=pub[:16],
+                             boundary=entry["boundary"])
             if kind == "join":
                 if pub == self.core.pub_hex:
                     self.core.adopt_membership()
@@ -655,6 +867,7 @@ class Node:
             self._pool_since = time.monotonic()
         self.transaction_pool.append(tx)
         self._m_submitted_tx.inc()
+        self.lineage.note_tx(tx, "pool")
 
     def _take_payload(self) -> List[bytes]:
         """Pop up to ``coalesce_max`` pooled txs for the next minted
@@ -708,6 +921,8 @@ class Node:
                 "undetermined_events", 0)   # host mirror: no device sync
             if undet > limit:
                 self._m_mint_backpressure.inc()
+                self.flight.note_limited("mint_backpressure",
+                                         backlog=undet)
                 self._pool_since = time.monotonic()   # re-arm, don't spin
                 return
             batches: List[List[bytes]] = []
@@ -824,6 +1039,10 @@ class Node:
                         def work():
                             diff = self.core.diff(known_view)
                             prefix = _push_prefix(diff)
+                            for ev in prefix:
+                                self.lineage.note_event(
+                                    ev.hex(), "ship", peer=peer_addr
+                                )
                             head = self.core.head
                             if len(prefix) < len(diff):
                                 # truncated frame: our absolute head is
@@ -946,6 +1165,10 @@ class Node:
         async with self.core_lock:
             def work():
                 diff = self.core.diff(req.known)
+                for ev in diff:
+                    self.lineage.note_event(
+                        ev.hex(), "ship", peer=req.from_addr
+                    )
                 return (self.core.to_wire(diff), self.core.head,
                         self.core.known())
 
@@ -1362,6 +1585,7 @@ class Node:
             return
         self._fast_forwarding = True
         self._m_ff_total.inc()
+        self.flight.note("ff_attempt", peer=peer_addr)
         t_ff = time.perf_counter()
         try:
             resp = await self.transport.request(
@@ -1500,6 +1724,9 @@ class Node:
                 "fast-forwarded from %s: %d events in window, lcr=%s",
                 peer_addr, window_len, engine._lcr_cache,
             )
+            self.flight.note("ff_adopt", peer=peer_addr,
+                             lcr=int(engine._lcr_cache),
+                             window=window_len)
             # The app missed every commit between its last delivery and
             # the snapshot cursor — surface the gap so state-machine apps
             # can restore from their own snapshot (the babbleio fast-sync
@@ -1517,6 +1744,7 @@ class Node:
             # the current engine — the next too_late gossip retries the
             # fast-forward against another (honest) peer
             self._m_ff_rejects.inc()
+            self.flight.note("ff_reject", peer=peer_addr, reason=str(e))
             self.logger.warning(
                 "fast-forward snapshot from %s REJECTED: %s", peer_addr, e
             )
@@ -1566,6 +1794,7 @@ class Node:
             if self.core.probing and self.core.probe_note(resp.from_addr):
                 # seq skip-ahead resolved: a supermajority answered, the
                 # engine head is the max published seq any of them saw
+                self.flight.note("probe_resolved", seq=self.core.seq + 1)
                 self.logger.warning(
                     "seq probe complete: resuming mints at seq %d",
                     self.core.seq + 1,
@@ -1610,12 +1839,51 @@ class Node:
             "sync %d events, consensus %.1fms",
             n_events, (t2 - t1) * 1e3,
         )
+        self._note_flush_obs(kc, new_events)
         if new_events:
             # enqueue under the lock: batches reach the committer in
             # consensus order even when gossip tasks overlap
             self._commit_queue.put_nowait(new_events)
         # membership plane: the run may have applied an epoch boundary
         self._sync_membership()
+        self._sample_health()
+
+    def _note_flush_obs(self, kc, new_events) -> None:
+        """Post-consensus observability bookkeeping (ISSUE 11): lineage
+        commit records, flush-byte estimates, and flight-recorder
+        transitions (kernel fallback, eviction horizon advance) — all
+        host-mirror reads on the consensus path, where the views are
+        already warm."""
+        hg = self.core.hg
+        for ev in new_events:
+            self.lineage.note_commit(
+                ev.hex(), ev.transactions, ev.round_received
+            )
+        fb = getattr(hg, "last_flush_bytes", None)
+        if fb is not None:
+            self._m_flush_bytes.observe(fb["total"])
+            for ph in ("ingest", "fame", "order"):
+                self._m_flush_bytes_phase.labels(ph).inc(fb[ph])
+            hg.last_flush_bytes = None   # book each flush exactly once
+        seen = self._flight_seen
+        fallbacks = int(getattr(hg, "flush_fallbacks", 0))
+        if fallbacks > seen["fallbacks"]:
+            self.flight.note_limited("kernel_fallback", total=fallbacks)
+        seen["fallbacks"] = fallbacks
+        if kc is not None and kc != seen["kernel"]:
+            if seen["kernel"] is not None:
+                # rate-limited: a catch-up phase can flip the dispatch
+                # per flush, and per-flip records would wash the ring
+                self.flight.note_limited("kernel_class", to=kc)
+            seen["kernel"] = kc
+        heads = getattr(getattr(hg, "dag", None), "evicted_heads", None)
+        if heads:
+            for cid, horizon in heads.items():
+                prev = seen["horizons"].get(cid)
+                if prev is None or horizon[0] > prev:
+                    seen["horizons"][cid] = horizon[0]
+                    self.flight.note("eviction_horizon", creator=cid,
+                                     index=horizon[0])
 
     async def _consensus_loop(self) -> None:
         """Dedicated consensus cadence (Config.consensus_interval > 0):
@@ -1652,6 +1920,7 @@ class Node:
             events = await self._commit_queue.get()
             t0 = time.perf_counter()
             txs = [tx for ev in events for tx in ev.transactions]
+            all_txs = txs
             if use_batch is not None and txs:
                 try:
                     await self._deliver(use_batch, txs, len(txs),
@@ -1672,8 +1941,13 @@ class Node:
                     use_batch = None
             for tx in txs:
                 await self._deliver(self.proxy.commit_tx, tx, 1)
+            for tx in all_txs:
+                self.lineage.note_tx(tx, "deliver")
             dur = time.perf_counter() - t0
             self._m_commit_latency.observe(dur)
+            lat = self._health["commit_lat"]
+            lat.append(dur)
+            del lat[:-128]
             self.tracer.record("commit_batch", dur, events=len(events))
             # completion signal for Queue.join() waiters: "queue empty"
             # alone cannot distinguish drained from batch-in-flight (the

@@ -1,24 +1,30 @@
-"""Observability: metrics registry, span tracer, event-loop probe.
+"""Observability: metrics, spans, and the attribution plane.
 
 Stdlib-only by contract — this package is imported by the analysis/CI
 layer and must work where jax and cryptography are absent.  Three
-pieces (ISSUE 2):
+tiers:
 
 - :mod:`.metrics` — Counter/Gauge/Histogram registry with
   Prometheus-text exposition (served at ``/metrics`` by
   ``service.Service``), safe from the event loop and the worker
-  threads that drive the device pipeline.
+  threads that drive the device pipeline (ISSUE 2).
 - :mod:`.spans` — bounded-ring span tracer with a context-manager /
   decorator API; parent/child wall-clock trees for a full
   submit→gossip→device-step→commit cycle (served at ``/debug/spans``).
-- :mod:`.probe` — asyncio event-loop-lag probe (one histogram saying
-  whether the loop itself is starved).
+  :mod:`.probe` — asyncio event-loop-lag probe.
+- :mod:`.lineage` + :mod:`.flight` — the cross-node tier (ISSUE 11):
+  per-tx/per-event lifecycle ledgers hash-joined fleet-wide into one
+  stitched timeline (``/debug/lineage`` + ``fleet trace``), and the
+  bounded state-transition ring every crash and chaos violation dumps
+  (``/debug/flight``).
 
-Each :class:`~babble_tpu.node.node.Node` owns one ``Registry`` + one
-``SpanTracer``; fleet-wide collection is a ``/metrics`` sweep
-(``fleet.scrape_hosts`` / ``babble-tpu fleet scrape``).
+Each :class:`~babble_tpu.node.node.Node` owns one of each; fleet-wide
+collection is a sweep (``fleet scrape`` / ``fleet health`` /
+``fleet trace``).
 """
 
+from .flight import FlightRecorder
+from .lineage import LineageRecorder, stitch, tx_id
 from .metrics import (
     LATENCY_BUCKETS,
     SIZE_BUCKETS,
@@ -35,10 +41,14 @@ __all__ = [
     "LATENCY_BUCKETS",
     "SIZE_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "LineageRecorder",
     "MetricFamily",
     "Registry",
     "LoopLagProbe",
     "SpanTracer",
+    "stitch",
+    "tx_id",
 ]

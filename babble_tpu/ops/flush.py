@@ -254,14 +254,117 @@ def live_flush_impl(
     then windowed fame and order, all inside one program so the state
     never leaves the device between phases.  ``batch`` may be empty
     (k=0, the drain call when gossip stops): the ingest phases are
-    no-ops on padded lanes and fame/order still advance."""
-    state = ingest_coords_impl(cfg, state, "incremental", batch)
-    state = ingest_rounds_impl(cfg, state, "incremental", batch)
+    no-ops on padded lanes and fame/order still advance.
+
+    The ``named_scope`` regions carry phase attribution into device
+    profiles (xprof/tensorboard via /debug/trace): HLO ops inherit the
+    scope name, so a trace of the single fused launch still splits its
+    device time ingest/fame/order.  Pure metadata — the compiled
+    numerics are bit-identical with or without them."""
+    with jax.named_scope("babble_ingest"):
+        state = ingest_coords_impl(cfg, state, "incremental", batch)
+        state = ingest_rounds_impl(cfg, state, "incremental", batch)
     lcr_prev = state.lcr
-    state = fame_window_impl(cfg, W, state, gate)
-    return order_window_impl(cfg, W, state, lcr_prev)
+    with jax.named_scope("babble_fame"):
+        state = fame_window_impl(cfg, W, state, gate)
+    with jax.named_scope("babble_order"):
+        return order_window_impl(cfg, W, state, lcr_prev)
 
 
 live_flush = jax.jit(
     live_flush_impl, static_argnums=(0, 1, 2), donate_argnums=(3,)
 )
+
+
+# ----------------------------------------------------------------------
+# phase probe (ISSUE 11 (c)): the fused flush as three separately-timed
+# sub-programs.  Same impl functions in the same order, so results are
+# bit-identical to the single launch (tests/test_obs_device.py parity);
+# each dispatch is host-synced, which is the probe's cost — a profiling
+# posture (Config.phase_probe), not the default path.
+
+
+def _ingest_flush_impl(cfg, state, batch):
+    state = ingest_coords_impl(cfg, state, "incremental", batch)
+    return ingest_rounds_impl(cfg, state, "incremental", batch)
+
+
+def _fame_flush_impl(cfg, W, gate, state):
+    # lcr_prev must be captured BEFORE fame advances it; returning it
+    # as an output keeps it valid under input donation
+    lcr_prev = state.lcr
+    return fame_window_impl(cfg, W, state, gate), lcr_prev
+
+
+_ingest_flush = jax.jit(
+    _ingest_flush_impl, static_argnums=(0,), donate_argnums=(1,)
+)
+_fame_flush = jax.jit(
+    _fame_flush_impl, static_argnums=(0, 1, 2), donate_argnums=(3,)
+)
+_order_flush = jax.jit(
+    order_window_impl, static_argnums=(0, 1), donate_argnums=(2,)
+)
+
+
+def probed_flush(cfg: DagConfig, W: int, gate: bool,
+                 state: DagState, batch: EventBatch):
+    """Run one live flush as three timed dispatches.  Returns
+    ``(state, {"ingest_s", "fame_s", "order_s"})`` with wall times
+    measured to completion (block_until_ready per phase)."""
+    import time
+
+    t0 = time.perf_counter()
+    state = jax.block_until_ready(_ingest_flush(cfg, state, batch))
+    t1 = time.perf_counter()
+    state, lcr_prev = jax.block_until_ready(
+        _fame_flush(cfg, W, gate, state)
+    )
+    t2 = time.perf_counter()
+    state = jax.block_until_ready(_order_flush(cfg, W, state, lcr_prev))
+    t3 = time.perf_counter()
+    return state, {"ingest_s": t1 - t0, "fame_s": t2 - t1,
+                   "order_s": t3 - t2}
+
+
+# ----------------------------------------------------------------------
+# bytes-touched estimates (ISSUE 11 (c)): a per-flush HBM-traffic model
+# derived from the live DagState shapes, so ROADMAP item 4's
+# frontier/bit-packing work has a before/after meter without tracing.
+# These are first-order ESTIMATES of bytes moved (reads + writes of the
+# dominant tensors), not measurements: constants assume i32/f32 lanes
+# and count each logical pass over a tensor once.
+
+
+def flush_bytes_estimate(cfg: DagConfig, W: int, k: int) -> dict:
+    """Estimated bytes touched by one fused latency flush of ``k``
+    events over a W-round window.  Per phase:
+
+    - **ingest**: each event's coordinate scatter reads two parent rows
+      and min-merges its fd row over [N] lanes (~6 row passes), plus
+      la/seq/level bookkeeping.
+    - **fame**: the [W, N, N] witness tensors (law/fd/ss/see/votes,
+      ~6 of them) built once, then the diagonal vote recursion touches
+      ~3 of them per of up to W steps.
+    - **order**: W reception scans over the [E+1, N] fd table plus the
+      median gather rows.
+    """
+    n, e1 = cfg.n, cfg.e_cap + 1
+    ingest = 6 * k * n * 4
+    fame = (6 + 3 * W) * W * n * n * 4
+    order = (W + 2) * e1 * n * 4
+    return {"ingest": ingest, "fame": fame, "order": order,
+            "total": ingest + fame + order}
+
+
+def throughput_bytes_estimate(cfg: DagConfig, k: int) -> dict:
+    """Same model for the legacy full-table surface: fame re-gathers
+    [R, N, N] witness tensors over all r_cap rounds and order rescans
+    every round against the full [E+1, N] fd table — which is exactly
+    why the windowed latency kernel exists."""
+    n, e1, R = cfg.n, cfg.e_cap + 1, cfg.r_cap
+    ingest = 6 * k * n * 4
+    fame = (6 + 3 * R) * R * n * n * 4
+    order = (R + 2) * e1 * n * 4
+    return {"ingest": ingest, "fame": fame, "order": order,
+            "total": ingest + fame + order}

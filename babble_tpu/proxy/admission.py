@@ -122,8 +122,18 @@ class AdmissionQueue:
         self._data = asyncio.Event()
         self._m_shed = None
         self._m_admitted = None
+        #: attribution plane (ISSUE 11): the owning node's lineage and
+        #: flight recorders, bound late like the registry — the front
+        #: door records each tx's submit/admit/shed verdict and shed
+        #: EPISODES land on the flight ring (rate-limited)
+        self._lineage = None
+        self._flight = None
         if registry is not None:
             self.instrument(registry)
+
+    def bind_observability(self, lineage, flight) -> None:
+        self._lineage = lineage
+        self._flight = flight
 
     def instrument(self, registry) -> None:
         self._m_shed = registry.counter(
@@ -212,16 +222,16 @@ class AdmissionQueue:
         """Admit one transaction for ``client`` or shed it with a
         structured OverloadedError."""
         self._note_drain(0)   # close stale windows: no drain = decay
+        if self._lineage is not None:
+            self._lineage.note_tx(tx, "submit", client=client)
         total = self.effective_total()
         if self._size >= total:
-            if self._m_shed is not None:
-                self._m_shed.labels("total").inc()
+            self._note_shed(tx, "total", self._size, total)
             raise OverloadedError("total", self._size, total)
         per_client = self.effective_per_client()
         q = self._queues.get(client)
         if q is not None and len(q) >= per_client:
-            if self._m_shed is not None:
-                self._m_shed.labels("client").inc()
+            self._note_shed(tx, "client", len(q), per_client)
             raise OverloadedError("client", len(q), per_client)
         if q is None:
             q = deque()
@@ -230,7 +240,21 @@ class AdmissionQueue:
         self._size += 1
         if self._m_admitted is not None:
             self._m_admitted.inc()
+        if self._lineage is not None:
+            self._lineage.note_tx(tx, "admit")
         self._data.set()
+
+    def _note_shed(self, tx: bytes, scope: str, depth: int,
+                   cap: int) -> None:
+        if self._m_shed is not None:
+            self._m_shed.labels(scope).inc()
+        if self._lineage is not None:
+            self._lineage.note_tx(tx, "shed", scope=scope)
+        if self._flight is not None:
+            # a shed EPISODE is one flight record, not one per tx — a
+            # bombard burst must not evict the interesting transitions
+            self._flight.note_limited("admission_shed", scope=scope,
+                                      depth=depth, cap=cap)
 
     # queue-compat writers (tests / in-process harnesses)
 

@@ -44,6 +44,11 @@ class SocketAppProxy:
         (the same late-binding seam the transports use)."""
         self.submit_queue.instrument(registry)
 
+    def bind_observability(self, lineage, flight) -> None:
+        """Bind the owning node's lineage/flight recorders so the front
+        door records each tx's submit/admit/shed verdict (ISSUE 11)."""
+        self.submit_queue.bind_observability(lineage, flight)
+
     async def start(self) -> None:
         await self.server.start()
 

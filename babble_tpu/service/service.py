@@ -10,10 +10,19 @@ Beyond the reference's flat string map, the node's telemetry registry
   node's metric registry: counters, gauges, and the latency/size
   histograms behind the /Stats ``*_ms`` keys.  Read-only, same trust
   level as /Stats, so not loopback-gated.
+- ``/healthz``      — the consensus-health verdict (ISSUE 11 (d)):
+  minting blocked and why, probe/epoch state, round-advancement rate,
+  quorum margin, commit-SLO burn, per-creator lag.  Host mirrors only;
+  ungated like /metrics (``fleet health`` sweeps it remotely).
 - ``/debug/spans``  — the span tracer's bounded ring as parent/child
   wall-clock trees (one tree per gossip/consensus/commit cycle), plus
   the drop counter so truncation is distinguishable from quiescence.
   Loopback-gated like the other /debug endpoints.
+- ``/debug/lineage?tx=<sha256 hex>`` — this node's commit-lineage
+  records for one tx plus the ledgers of every event they hash-join
+  to (ISSUE 11 (a); ``fleet trace`` stitches the fleet's dumps).
+- ``/debug/flight`` — the flight recorder's bounded ring of state
+  transitions (ISSUE 11 (b)).
 
 The reference piggy-backs Go pprof on the same listener (cmd/main.go:26,
 ``import _ "net/http/pprof"``); the equivalents here are the profilers
@@ -79,6 +88,33 @@ class Service:
                 "capacity": tracer.capacity,
                 "dropped": tracer.dropped,
                 "trees": tracer.trees(),
+            })
+            return body.encode(), "200 OK", "application/json"
+        if path == "/debug/lineage":
+            # commit-lineage lookup (ISSUE 11): everything this node
+            # recorded about one tx — its lifecycle records plus the
+            # full ledgers of every event they hash-join to.  `fleet
+            # trace` sweeps this across nodes and stitches one timeline.
+            recorder = getattr(self.node, "lineage", None)
+            if recorder is None:
+                return (b'{"error": "node has no lineage recorder"}',
+                        "404 Not Found", "application/json")
+            txid = (query.get("tx", [""])[0] or "").strip().lower()
+            if not txid:
+                body = json.dumps({"stats": recorder.stats()})
+                return body.encode(), "200 OK", "application/json"
+            dump = recorder.lookup_tx(txid)
+            dump["stats"] = recorder.stats()
+            return (json.dumps(dump).encode(), "200 OK",
+                    "application/json")
+        if path == "/debug/flight":
+            flight = getattr(self.node, "flight", None)
+            if flight is None:
+                return (b'{"error": "node has no flight recorder"}',
+                        "404 Not Found", "application/json")
+            body = json.dumps({
+                "stats": flight.stats(),
+                "records": flight.dump(),
             })
             return body.encode(), "200 OK", "application/json"
         if path == "/debug/stack":
@@ -163,6 +199,17 @@ class Service:
         if path.lower() == "/stats":
             body = json.dumps(self.node.get_stats()).encode()
             status = "200 OK"
+        elif path == "/healthz":
+            # consensus-health verdict (ISSUE 11 (d)): host mirrors
+            # only, same trust level as /Stats — `fleet health`
+            # aggregates it fleet-wide and flags divergence
+            health = getattr(self.node, "healthz", None)
+            if health is None:
+                body = b'{"error": "node has no health surface"}'
+                status = "404 Not Found"
+            else:
+                body = json.dumps(health()).encode()
+                status = "200 OK"
         elif path == "/metrics":
             registry = getattr(self.node, "registry", None)
             if registry is None:

@@ -271,6 +271,32 @@ def fetch_spans(service_addr: str, timeout: float = 3.0) -> Dict:
         return json.load(r)
 
 
+def fetch_healthz(service_addr: str, timeout: float = 3.0) -> Dict:
+    """One node's /healthz consensus-health verdict (ISSUE 11)."""
+    with urllib.request.urlopen(
+        f"http://{service_addr}/healthz", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def fetch_lineage(service_addr: str, txid: str,
+                  timeout: float = 3.0) -> Dict:
+    """One node's commit-lineage dump for ``txid`` (/debug/lineage —
+    loopback-gated like the other /debug endpoints)."""
+    with urllib.request.urlopen(
+        f"http://{service_addr}/debug/lineage?tx={txid}", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def fetch_flight(service_addr: str, timeout: float = 3.0) -> Dict:
+    """One node's flight-recorder dump (/debug/flight, loopback-gated)."""
+    with urllib.request.urlopen(
+        f"http://{service_addr}/debug/flight", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
 def watch_once(n: int, ports: Optional[PortLayout] = None) -> List[Dict[str, str]]:
     """One /Stats sweep across the fleet (reference docker/scripts/watch.sh)."""
     ports = ports or PortLayout()

@@ -1,0 +1,343 @@
+"""Consensus-health plane + flight recorder (ISSUE 11 (b)/(d) and
+satellites): /healthz verdict fields, fleet divergence flagging, the
+scrape rollup, flight ring bounds/rate-limiting, admission hook
+records, and the chaos runner's violation post-mortems.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from babble_tpu.crypto.keys import generate_key
+from babble_tpu.net import InmemNetwork, Peer
+from babble_tpu.node import Config, Node
+from babble_tpu.obs import FlightRecorder
+from babble_tpu.proxy.inmem import InmemAppProxy
+
+# ----------------------------------------------------------------------
+# flight recorder unit tests
+
+
+def test_flight_ring_bounds():
+    f = FlightRecorder(capacity=3)
+    for i in range(5):
+        f.note("k", i=i)
+    recs = f.dump()
+    assert len(recs) == 3
+    assert [r["i"] for r in recs] == [2, 3, 4]
+    assert f.dropped == 2
+
+
+def test_flight_rate_limit_coalesces_episodes():
+    f = FlightRecorder()
+    for _ in range(100):
+        f.note_limited("admission_shed", min_interval_s=60.0, scope="total")
+    recs = [r for r in f.dump() if r["kind"] == "admission_shed"]
+    # one ring record for the episode, the 99 absorbed occurrences
+    # flushed as a coalesced tail at dump time
+    assert len(recs) == 2
+    assert recs[0]["count"] == 1
+    assert recs[1]["count"] == 99 and recs[1]["coalesced_tail"]
+
+
+def test_flight_disabled_noop():
+    f = FlightRecorder(enabled=False)
+    f.note("x")
+    f.note_limited("y")
+    assert f.dump() == []
+
+
+# ----------------------------------------------------------------------
+# /healthz
+
+
+def _make_node(**conf_kw):
+    net = InmemNetwork()
+    key = generate_key()
+    t = net.transport()
+    peers = [Peer(net_addr=t.local_addr(), pub_key_hex=key.pub_hex)]
+    conf = Config.test_config()
+    for k, v in conf_kw.items():
+        setattr(conf, k, v)
+    node = Node(conf, key, peers, t, InmemAppProxy())
+    node.init()
+    return node
+
+
+def test_healthz_fields_and_ok_status():
+    async def go():
+        node = _make_node()
+        async with node.core_lock:
+            await node._run_consensus_locked(0)
+        h = node.healthz()
+        for key in ("status", "minting_blocked", "reasons", "probe_armed",
+                    "epoch_pending", "epoch", "lcr", "commit_length",
+                    "digest", "digest_anchor", "round_advance_rate",
+                    "quorum_margin", "active_n", "commit_slo_burn",
+                    "creator_lags", "behind_horizon", "undetermined"):
+            assert key in h, f"missing {key}"
+        assert h["status"] == "ok"
+        assert h["minting_blocked"] is False and h["reasons"] == []
+        assert h["epoch"] == 0 and h["active_n"] == 1
+        json.dumps(h)   # must be JSON-able as served
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+def test_healthz_observer_is_degraded():
+    """A declared joiner (bootstrap_peers set, key outside the epoch's
+    set) is minting-blocked: /healthz must say so, not look healthy."""
+    net = InmemNetwork()
+    founders = sorted([generate_key() for _ in range(2)],
+                      key=lambda k: k.pub_hex)
+    me = generate_key()
+    ftrans = [net.transport() for _ in founders]
+    fpeers = [Peer(net_addr=t.local_addr(), pub_key_hex=k.pub_hex)
+              for t, k in zip(ftrans, founders)]
+    t = net.transport()
+    conf = Config.test_config()
+    conf.bootstrap_peers = fpeers
+    node = Node(conf, me,
+                fpeers + [Peer(net_addr=t.local_addr(),
+                               pub_key_hex=me.pub_hex)],
+                t, InmemAppProxy())
+    node.init()
+    h = node.healthz()
+    assert h["status"] == "degraded"
+    assert h["minting_blocked"] is True
+    assert "observer" in h["reasons"]
+
+
+def test_healthz_stall_detected_when_consensus_stops():
+    """A node whose consensus stopped running (full partition) must
+    not replay its pre-outage rate forever: the last sample's age
+    enters the denominator and flips the stalled flag."""
+    import time as _time
+
+    node = _make_node()
+    now = _time.monotonic()
+    # healthy-looking history whose NEWEST sample is 60s old
+    node._health["lcr_samples"] = [(now - 100.0, 5), (now - 60.0, 10)]
+    assert node.core.stats_snapshot()["undetermined_events"] > 0
+    h = node.healthz()
+    assert h["consensus_idle_s"] > 30
+    assert h["stalled"] is True and h["status"] == "degraded"
+    # the rate is measured to NOW (decays), not over the stale window
+    assert h["round_advance_rate"] < (10 - 5) / 40.0
+
+
+def test_healthz_no_phantom_horizon_when_eviction_disabled():
+    """inactive_rounds None/0 disables per-creator eviction (the PR-8
+    convention) — /healthz must not report creators 'behind' a horizon
+    that does not exist."""
+    node = _make_node(inactive_rounds=None)
+    node._health["creator_lags"] = {0: 0, 1: 500}
+    h = node.healthz()
+    assert h["behind_horizon"] == []
+    # with the policy ON the same lag IS reported
+    node.conf.inactive_rounds = 32
+    assert node.healthz()["behind_horizon"] == [1]
+
+
+def test_healthz_endpoint_served():
+    """GET /healthz answers the verdict (not loopback-gated: same trust
+    level as /Stats — fleet health sweeps it remotely)."""
+    import urllib.request
+
+    from babble_tpu.service.service import Service
+
+    async def go():
+        node = _make_node()
+        svc = Service("127.0.0.1:0", node)
+        await svc.start()
+        loop = asyncio.get_running_loop()
+
+        def get():
+            with urllib.request.urlopen(
+                f"http://{svc.bind_addr}/healthz", timeout=10
+            ) as r:
+                return r.status, json.load(r)
+
+        st, body = await loop.run_in_executor(None, get)
+        assert st == 200
+        assert body["status"] in ("ok", "degraded")
+        assert "digest" in body
+        await svc.close()
+        await node.shutdown()
+
+    asyncio.run(go())
+
+
+# ----------------------------------------------------------------------
+# fleet health divergence + rollup (satellite 1)
+
+
+def _health_row(host, **kw):
+    h = {"status": "ok", "epoch": 0, "lcr": 10, "commit_length": 50,
+         "digest": "d0", "round_advance_rate": 1.0, "quorum_margin": 1,
+         "commit_slo_burn": 0.0, "reasons": [], "behind_horizon": []}
+    h.update(kw)
+    return {"host": host, "health": h}
+
+
+def test_health_divergence_epoch_and_digest():
+    from babble_tpu import fleet as fl
+
+    rows = [
+        _health_row("a:1"),
+        _health_row("b:1", epoch=1),
+        _health_row("c:1", digest="d-FORGED"),
+    ]
+    div = fl.health_divergence(rows)
+    kinds = {d["kind"] for d in div}
+    assert "epoch" in kinds, div
+    # a:1 and c:1 sit at the same position 50 with different digests
+    dig = next(d for d in div if d["kind"] == "digest")
+    assert dig["severity"] == "error" and dig["position"] == 50
+    text = fl.format_health(rows, div)
+    assert "FLEET DIVERGENCE" in text
+
+
+def test_health_divergence_lcr_lag_is_warning():
+    from babble_tpu import fleet as fl
+
+    rows = [_health_row("a:1", lcr=100), _health_row("b:1", lcr=10)]
+    div = fl.health_divergence(rows)
+    assert [d["kind"] for d in div] == ["lcr_lag"]
+    assert div[0]["severity"] == "warning"
+    assert "b:1" in div[0]["values"]
+
+
+def test_health_no_divergence_clean_table():
+    from babble_tpu import fleet as fl
+
+    rows = [_health_row("a:1"), _health_row("b:1")]
+    assert fl.health_divergence(rows) == []
+    assert "no cross-node divergence" in fl.format_health(rows, [])
+
+
+def test_rollup_sums_counters_maxes_gauges_flags_divergence():
+    from babble_tpu import fleet as fl
+
+    def blob(epoch, txs, depth):
+        return (
+            "# TYPE babble_epoch gauge\n"
+            f"babble_epoch {epoch}\n"
+            "# TYPE babble_commit_tx_total counter\n"
+            f"babble_commit_tx_total {txs}\n"
+            "# TYPE babble_ingress_queue_depth gauge\n"
+            f"babble_ingress_queue_depth {depth}\n"
+            "# TYPE babble_flush_seconds histogram\n"
+            'babble_flush_seconds_bucket{kernel="latency",le="+Inf"} 4\n'
+            f'babble_flush_seconds_count{{kernel="latency"}} 4\n'
+        )
+
+    rows = [
+        {"host": "a:1", "metrics": blob(0, 100, 5)},
+        {"host": "b:1", "metrics": blob(1, 50, 9)},
+        {"host": "c:1", "error": "boom", "kind": "unreachable"},
+    ]
+    r = fl.rollup_metrics(rows)
+    assert r["series"]["babble_commit_tx_total"]["sum"] == 150
+    assert r["series"]["babble_ingress_queue_depth"]["max"] == 9
+    bucket = 'babble_flush_seconds_bucket{kernel="latency",le="+Inf"}'
+    assert r["series"][bucket]["sum"] == 8
+    assert r["unparsed"] == ["c:1"]
+    # nodes disagreeing on babble_epoch render as an ERROR row (a
+    # split epoch ledger), never a silent average
+    assert len(r["divergence"]) == 1
+    d = r["divergence"][0]
+    assert d["series"] == "babble_epoch"
+    assert d["severity"] == "error"
+    assert d["values"] == {"a:1": 0.0, "b:1": 1.0}
+    text = fl.format_rollup(r)
+    assert "FLEET DIVERGENCE" in text
+    assert "babble_commit_tx_total 150" in text
+    assert "babble_ingress_queue_depth sum=14 max=9" in text
+
+
+def test_host_port_entries_flagged_for_write_verbs():
+    """'host:service_port' entries are a read-only-sweep convenience;
+    the layout exposes the fact so the CLI can refuse conf/bombard."""
+    from babble_tpu import fleet as fl
+
+    assert fl.HostLayout(["127.0.0.1:15000"]).explicit_service_ports()
+    assert not fl.HostLayout(["10.0.0.1"]).explicit_service_ports()
+    # read path: the explicit port lands on the service addr only
+    lay = fl.HostLayout(["127.0.0.1:15003"])
+    assert lay.of(0)["service"] == "127.0.0.1:15003"
+
+
+def test_rollup_agreeing_fleet_has_no_divergence():
+    from babble_tpu import fleet as fl
+
+    blob = "# TYPE babble_epoch gauge\nbabble_epoch 2\n"
+    r = fl.rollup_metrics([{"host": "a:1", "metrics": blob},
+                           {"host": "b:1", "metrics": blob}])
+    assert r["divergence"] == []
+    assert "FLEET DIVERGENCE" not in fl.format_rollup(r)
+
+
+# ----------------------------------------------------------------------
+# admission front-door hooks
+
+
+def test_admission_records_submit_admit_shed():
+    from babble_tpu.obs import LineageRecorder, tx_id
+    from babble_tpu.proxy.admission import AdmissionQueue, OverloadedError
+
+    q = AdmissionQueue(per_client=1, total=8)
+    lineage, flight = LineageRecorder(), FlightRecorder()
+    q.bind_observability(lineage, flight)
+    q.submit_nowait("c1", b"t1")
+    with pytest.raises(OverloadedError):
+        q.submit_nowait("c1", b"t2")    # per-client cap
+    assert [r["stage"] for r in lineage.get("tx:" + tx_id(b"t1"))] == \
+        ["submit", "admit"]
+    assert [r["stage"] for r in lineage.get("tx:" + tx_id(b"t2"))] == \
+        ["submit", "shed"]
+    sheds = [r for r in flight.dump() if r["kind"] == "admission_shed"]
+    assert sheds and sheds[0]["scope"] == "client"
+
+
+# ----------------------------------------------------------------------
+# chaos post-mortems (satellite 2)
+
+
+def test_chaos_violation_attaches_flight_dumps():
+    """The intentionally-broken mini fork scenario fails fork_detected;
+    its result must carry per-node flight dumps and `--json` (to_dict)
+    must embed them — the post-mortem is part of the failure."""
+    from babble_tpu.chaos import Scenario, run_scenario
+    from tests.test_chaos_scenarios import _MINI_FORK
+
+    spec = dict(_MINI_FORK)
+    spec["name"] = "mini-fork-broken-flight"
+    spec["engine"] = "fused"
+    r = run_scenario(Scenario.from_dict(spec))
+    assert not r.report.ok
+    assert r.flight_dumps, "violation without flight dumps"
+    d = r.to_dict()
+    assert "flight" in d
+    json.dumps(d)    # chaos run --json must serialize it
+    # fingerprint stays flight-free: wall-clock records must never
+    # enter the reproducibility hash
+    assert "flight" not in json.dumps({
+        "schedule": [list(t) for t in r.fault_schedule]})
+
+
+def test_chaos_green_run_keeps_flight_out_of_json():
+    from babble_tpu.chaos import Scenario, run_scenario
+    from tests.test_chaos_scenarios import _MINI_FLAKY
+
+    r = run_scenario(Scenario.from_dict(_MINI_FLAKY))
+    assert r.report.ok, r.report.format()
+    assert "flight" not in r.to_dict()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))
