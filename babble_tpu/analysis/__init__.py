@@ -17,6 +17,11 @@ Rule families (see ISSUE 1/4 / the rules' module docstrings):
 - :mod:`.snapshotadopt` — engines built from peer-supplied snapshot
   bytes must reach the signed-state-proof verification helpers
   (``unverified-snapshot-adopt``)
+- :mod:`.device` — the device-plane family (ISSUE 12): donated-buffer
+  discipline (``donate-use-after-free``), static-arg bucketing
+  (``recompile-hazard``), partition-rule and SPMD-sentinel coverage
+  (``partition-spec-coverage``), flush-traffic-model coverage
+  (``bytes-model-coverage``)
 
 The flow-aware rules stand on :mod:`.graph` (module symbol table +
 project call graph), built once per run by the engine and attached to
@@ -51,6 +56,12 @@ from .graph import ProjectContext
 from .blocking import AsyncioBlockingCallRule
 from .codecloop import CodecOnLoopRule
 from .determinism import ConsensusNondeterminismRule
+from .device import (
+    BytesModelCoverageRule,
+    DonateUseAfterFreeRule,
+    PartitionSpecCoverageRule,
+    RecompileHazardRule,
+)
 from .guards import HeldGuardEscapeRule
 from .invariants import DrainBeforeValidateRule, FalsyOrFallbackRule
 from .races import AwaitStateRaceRule
@@ -79,6 +90,10 @@ ALL_RULES = [
     WalBeforeGossipRule(),
     UnverifiedSnapshotAdoptRule(),
     StaleQuorumMathRule(),
+    DonateUseAfterFreeRule(),
+    RecompileHazardRule(),
+    PartitionSpecCoverageRule(),
+    BytesModelCoverageRule(),
 ]
 
 RULE_NAMES = ({r.name for r in ALL_RULES}
@@ -100,15 +115,19 @@ __all__ = [
     "run_paths_cached",
     "AsyncioBlockingCallRule",
     "AwaitStateRaceRule",
+    "BytesModelCoverageRule",
     "CodecOnLoopRule",
     "ChaosUnseededRandomRule",
     "ConsensusNondeterminismRule",
+    "DonateUseAfterFreeRule",
     "DrainBeforeValidateRule",
     "FalsyOrFallbackRule",
     "HeldGuardEscapeRule",
     "JitHostSyncRule",
     "JitTracedBranchRule",
     "JitUnhashableStaticRule",
+    "PartitionSpecCoverageRule",
+    "RecompileHazardRule",
     "StaleQuorumMathRule",
     "UnverifiedSnapshotAdoptRule",
     "WalBeforeGossipRule",

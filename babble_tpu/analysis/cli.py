@@ -10,6 +10,10 @@ Exit status is the contract CI keys off: 0 = clean, 1 = findings,
   ``rule/path/line/col/message/suppressed`` — suppressed findings ARE
   emitted (that is the point of the flag: tooling audits what is
   waived), but only live findings drive the exit status;
+- ``--sarif``: one SARIF 2.1.0 document (the same finding stream as
+  ``--json``) so CI annotates findings inline on PRs — suppressed
+  findings ride along as level ``note`` with an ``inSource``
+  suppression object, live findings are ``warning``;
 - ``--format=json``: legacy single-array form (live findings only).
 
 ``--cache FILE`` keys the whole project-wide result on every file's
@@ -28,7 +32,58 @@ from typing import List, Optional
 
 from . import ALL_RULES
 from .cache import run_paths_cached
-from .engine import run_paths
+from .engine import Finding, Rule, run_paths
+
+_SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def sarif_document(findings: List[Finding],
+                   rules: List[Rule]) -> dict:
+    """The finding stream as one SARIF 2.1.0 run, for CI inline
+    annotation.  Locations are repo-relative URIs with 1-based
+    line/column regions; suppressed findings carry an ``inSource``
+    suppression object (SARIF's native waiver representation) and
+    level ``note`` so annotators render them dimmed, not failing."""
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "note" if f.suppressed else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/"),
+                    },
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        results.append(result)
+    return {
+        "version": "2.1.0",
+        "$schema": _SARIF_SCHEMA,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "babble-lint",
+                    "rules": [
+                        {
+                            "id": r.name,
+                            "shortDescription": {"text": r.description},
+                        }
+                        for r in sorted(rules, key=lambda r: r.name)
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -52,6 +107,11 @@ def main(argv: Optional[List[str]] = None) -> int:
              "suppressed findings flagged suppressed=true",
     )
     parser.add_argument(
+        "--sarif", action="store_true",
+        help="emit one SARIF 2.1.0 document (same finding stream as "
+             "--json) for CI inline annotation",
+    )
+    parser.add_argument(
         "--cache", default=None, metavar="FILE",
         help="whole-run result cache keyed on file mtime+size; an "
              "untouched tree skips re-parsing entirely",
@@ -65,6 +125,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run only the named rules (default: all)",
     )
     args = parser.parse_args(argv)
+
+    if args.json and args.sarif:
+        # each claims stdout whole — silently picking one would feed a
+        # SARIF upload step JSONL (or vice versa) with exit 0
+        print("--json and --sarif are mutually exclusive",
+              file=sys.stderr)
+        return 2
 
     rules = list(ALL_RULES)
     if args.rules:
@@ -91,7 +158,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from . import RULE_NAMES
 
-    include_suppressed = bool(args.json)
+    include_suppressed = bool(args.json or args.sarif)
     if args.cache:
         findings, _hit = run_paths_cached(
             args.paths, rules, args.cache, known_rules=RULE_NAMES,
@@ -105,6 +172,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.json:
         for f in findings:
             print(json.dumps(f.to_dict(), sort_keys=True))
+    elif args.sarif:
+        print(json.dumps(sarif_document(findings, rules), indent=2,
+                         sort_keys=True))
     elif args.format == "json":
         print(json.dumps([f.to_dict() for f in live], indent=2))
     else:

@@ -128,10 +128,20 @@ class ClassInfo:
     base_refs: List[str] = field(default_factory=list)      # raw dotted refs
     #: self.<attr> -> candidate class keys, from constructor assignments
     attr_types: Dict[str, Set[Tuple[str, str]]] = field(default_factory=dict)
+    #: class-body annotated field names, in declaration order — for
+    #: NamedTuple-derived classes this IS the constructor signature,
+    #: which the device-plane coverage rules diff against partition
+    #: specs and the bytes-traffic model
+    fields: List[str] = field(default_factory=list)
 
     @property
     def key(self) -> Tuple[str, str]:
         return (self.module, self.name)
+
+    @property
+    def is_namedtuple(self) -> bool:
+        return any(ref == "NamedTuple" or ref.endswith(".NamedTuple")
+                   for ref in self.base_refs)
 
 
 @dataclass
@@ -218,6 +228,9 @@ class ProjectContext:
                     if isinstance(sub, (ast.FunctionDef,
                                         ast.AsyncFunctionDef)):
                         self._register_function(mod, ci, sub)
+                    elif (isinstance(sub, ast.AnnAssign)
+                            and isinstance(sub.target, ast.Name)):
+                        ci.fields.append(sub.target.id)
 
     @staticmethod
     def _import_base(module: str, level: int,

@@ -37,6 +37,8 @@ a restart can pre-compile them against the persistent XLA cache.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
@@ -53,6 +55,8 @@ from .state import (
     DagConfig,
     DagState,
     I32,
+    PER_EVENT_FIELDS,
+    PER_ROUND_FIELDS,
     head_round_min_math,
     sanitize,
 )
@@ -332,29 +336,98 @@ def probed_flush(cfg: DagConfig, W: int, gate: bool,
 # derived from the live DagState shapes, so ROADMAP item 4's
 # frontier/bit-packing work has a before/after meter without tracing.
 # These are first-order ESTIMATES of bytes moved (reads + writes of the
-# dominant tensors), not measurements: constants assume i32/f32 lanes
-# and count each logical pass over a tensor once.
+# dominant tensors), not measurements: each entry counts logical passes
+# over one tensor.
+#
+# The model is FIELD-ITEMIZED (ISSUE 12): every per-event and per-round
+# DagState tensor (ops/state.py PER_EVENT_FIELDS / PER_ROUND_FIELDS)
+# must own a FIELD_TRAFFIC row, or the ``bytes-model-coverage`` lint
+# rule fails the build — the meter stays honest as fields are added,
+# instead of silently under-counting new state.  Keys beyond the
+# DagState fields (the ``derived:*`` rows) model kernel temporaries
+# (vote tensors, the median sort double) that dominate fame/order but
+# are not persistent state.
+
+
+class TrafficDims(NamedTuple):
+    """Shape/dtype inputs to one traffic row: participant width, event
+    rows, round window (W for the latency kernel, r_cap for the
+    full-table surface), batch size, coordinate itemsize."""
+
+    n: int
+    e1: int
+    w: int
+    k: int
+    isz: int
+
+
+#: field (or ``derived:*`` temporary) -> ((phase, bytes_fn), ...).
+#: bytes_fn maps TrafficDims to estimated bytes touched in that phase.
+FIELD_TRAFFIC = {
+    # per-event bookkeeping lanes: written once per ingested event
+    "sp": (("ingest", lambda d: 4 * d.k),),
+    "op": (("ingest", lambda d: 4 * d.k),),
+    "creator": (("ingest", lambda d: 4 * d.k),),
+    "seq": (("ingest", lambda d: 4 * d.k),
+            ("fame", lambda d: 4 * d.w * d.n),       # seqw window gather
+            ("order", lambda d: 4 * d.w * d.n)),
+    "ts": (("ingest", lambda d: 8 * d.k),
+           ("order", lambda d: 8 * d.e1)),           # median source rows
+    "mbit": (("ingest", lambda d: d.k),
+             ("fame", lambda d: d.w * d.n)),         # coin-round bits
+    # coordinate tensors: the dominant HBM residents.  ingest reads two
+    # parent rows and writes/min-merges the new rows (~3 [N] passes
+    # each); fame gathers the [W, N, N] witness tables (la twice: law +
+    # law_next); order scans fd against every window round's witnesses.
+    "la": (("ingest", lambda d: 3 * d.k * d.n * d.isz),
+           ("fame", lambda d: 2 * d.w * d.n * d.n * d.isz)),
+    "fd": (("ingest", lambda d: 3 * d.k * d.n * d.isz),
+           ("fame", lambda d: d.w * d.n * d.n * d.isz),
+           ("order", lambda d: d.w * d.e1 * d.n * d.isz)),
+    "round": (("ingest", lambda d: 4 * d.k),),
+    "witness": (("ingest", lambda d: d.k),),
+    "rr": (("order", lambda d: 2 * 4 * d.e1),),      # read mask + write
+    "cts": (("order", lambda d: 2 * 8 * d.e1),),
+    # per-round tables: window slices read (famous also written back)
+    "wslot": (("fame", lambda d: 4 * d.w * d.n),),
+    "famous": (("fame", lambda d: 2 * d.w * d.n),),
+    "sm": (("ingest", lambda d: 4 * d.k),),          # per-event threshold gather
+    # kernel temporaries, not DagState fields: the ss/see/vote [W, N, N]
+    # f32 tensors built once plus ~3 touched per diagonal vote step, and
+    # the order median's tv tensor + sort double
+    "derived:votes": (
+        ("fame", lambda d: 4 * (3 * d.w + 3 * d.w * d.w) * d.n * d.n),
+    ),
+    "derived:median": (("order", lambda d: 2 * 4 * d.e1 * d.n),),
+}
+
+# import-time twin of the bytes-model-coverage lint rule: a field that
+# reaches runtime unmodeled fails here even where the linter never ran
+assert set(FIELD_TRAFFIC) >= set(PER_EVENT_FIELDS) | set(PER_ROUND_FIELDS), (
+    "flush traffic model is missing DagState fields: "
+    f"{sorted((set(PER_EVENT_FIELDS) | set(PER_ROUND_FIELDS)) - set(FIELD_TRAFFIC))}"
+)
+
+
+def _traffic_estimate(cfg: DagConfig, window: int, k: int) -> dict:
+    d = TrafficDims(
+        n=cfg.n, e1=cfg.e_cap + 1, w=window, k=k,
+        isz=int(jnp.dtype(cfg.coord_dtype).itemsize),
+    )
+    out = {"ingest": 0, "fame": 0, "order": 0}
+    for rows in FIELD_TRAFFIC.values():
+        for phase, fn in rows:
+            out[phase] += int(fn(d))
+    out["total"] = out["ingest"] + out["fame"] + out["order"]
+    return out
 
 
 def flush_bytes_estimate(cfg: DagConfig, W: int, k: int) -> dict:
     """Estimated bytes touched by one fused latency flush of ``k``
-    events over a W-round window.  Per phase:
-
-    - **ingest**: each event's coordinate scatter reads two parent rows
-      and min-merges its fd row over [N] lanes (~6 row passes), plus
-      la/seq/level bookkeeping.
-    - **fame**: the [W, N, N] witness tensors (law/fd/ss/see/votes,
-      ~6 of them) built once, then the diagonal vote recursion touches
-      ~3 of them per of up to W steps.
-    - **order**: W reception scans over the [E+1, N] fd table plus the
-      median gather rows.
-    """
-    n, e1 = cfg.n, cfg.e_cap + 1
-    ingest = 6 * k * n * 4
-    fame = (6 + 3 * W) * W * n * n * 4
-    order = (W + 2) * e1 * n * 4
-    return {"ingest": ingest, "fame": fame, "order": order,
-            "total": ingest + fame + order}
+    events over a W-round window: the FIELD_TRAFFIC rows summed per
+    phase with the window set to W — the [W, N, N] witness tensors and
+    W reception scans replace the full-table r_cap passes."""
+    return _traffic_estimate(cfg, W, k)
 
 
 def throughput_bytes_estimate(cfg: DagConfig, k: int) -> dict:
@@ -362,9 +435,4 @@ def throughput_bytes_estimate(cfg: DagConfig, k: int) -> dict:
     [R, N, N] witness tensors over all r_cap rounds and order rescans
     every round against the full [E+1, N] fd table — which is exactly
     why the windowed latency kernel exists."""
-    n, e1, R = cfg.n, cfg.e_cap + 1, cfg.r_cap
-    ingest = 6 * k * n * 4
-    fame = (6 + 3 * R) * R * n * n * 4
-    order = (R + 2) * e1 * n * 4
-    return {"ingest": ingest, "fame": fame, "order": order,
-            "total": ingest + fame + order}
+    return _traffic_estimate(cfg, cfg.r_cap, k)

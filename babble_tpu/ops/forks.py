@@ -51,7 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.event import Event
-from .state import I32, I64, INT32_MAX, sanitize
+from .state import I32, I64, INT32_MAX, sanitize, set_sentinel
 from ..membership.quorum import supermajority
 
 F32 = jnp.float32
@@ -473,8 +473,12 @@ def _la_scan(cfg: ForkConfig, b: ForkBatch) -> jnp.ndarray:
         return la.at[idx_s].set(rows), None
 
     la, _ = jax.lax.scan(step, la0, b.sched)
-    # sentinel row stays -1 (pad lanes all dumped -1 rows into it)
-    return la.at[cfg.e_cap].set(-1)
+    # sentinel row stays -1 (pad lanes all dumped -1 rows into it).
+    # set_sentinel, not .at[e_cap].set: the pipeline runs sharded
+    # (make_sharded_fork_step) and a static-index row write clamps
+    # per shard under SPMD (ops/state.py set_sentinel docstring)
+    e_row = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
+    return set_sentinel(la, e_row, -1)
 
 
 def _detect(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray) -> jnp.ndarray:
@@ -533,7 +537,9 @@ def _fd_reverse(cfg: ForkConfig, b: ForkBatch) -> jnp.ndarray:
         return fd, None
 
     fd, _ = jax.lax.scan(step, fd0, b.sched[::-1])
-    return fd.at[cfg.e_cap].set(INT32_MAX)
+    # SPMD-safe sentinel restore (see _la_scan)
+    e_row = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
+    return set_sentinel(fd, e_row, INT32_MAX)
 
 
 def _fd_chains(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray) -> jnp.ndarray:
@@ -603,7 +609,9 @@ def _fd_chains(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray) -> jnp.ndarray:
         # land this chunk's columns: fd[ce[by, t], c0:c0+cb] = out[br, by, t]
         block = jnp.full((e1, cb), INT32_MAX, I32)
         block = block.at[tgt].set(out.transpose(1, 2, 0))     # [B, T, Cb]
-        block = block.at[cfg.e_cap].set(INT32_MAX)
+        block = set_sentinel(
+            block, (jnp.arange(e1) == cfg.e_cap)[:, None], INT32_MAX
+        )
         fd = jax.lax.dynamic_update_slice(fd, block, (0, c0))
     return fd[:, :B]
 
@@ -821,10 +829,12 @@ def _rounds_scan(cfg: ForkConfig, b: ForkBatch, la: jnp.ndarray,
     (rnd, wit, wslot, max_round), _ = jax.lax.scan(
         step, (rnd0, wit0, wslot0, jnp.asarray(-1, I32)), b.sched
     )
-    # restore dump row/sentinels
-    wslot = wslot.at[r_cap].set(-1)
-    rnd = rnd.at[cfg.e_cap].set(-1)
-    wit = wit.at[cfg.e_cap].set(False)
+    # restore dump row/sentinels (SPMD-safe selects, see _la_scan)
+    r_row = (jnp.arange(r_cap + 1) == r_cap)[:, None]
+    e_row = jnp.arange(cfg.e_cap + 1) == cfg.e_cap
+    wslot = set_sentinel(wslot, r_row, -1)
+    rnd = set_sentinel(rnd, e_row, -1)
+    wit = set_sentinel(wit, e_row, False)
     return rnd, wit, wslot, max_round
 
 

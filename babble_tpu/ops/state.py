@@ -214,6 +214,23 @@ class DagState(NamedTuple):
     r_off: jnp.ndarray     # i32      absolute round of wslot/famous row 0
 
 
+#: Axis classification of every DagState field — the single source of
+#: truth the device-plane lint rules consume (``bytes-model-coverage``):
+#: the four tuples must PARTITION DagState._fields exactly, so a new
+#: field fails lint until someone states which axis it grows along, and
+#: every per-event/per-round tensor must then appear in the flush
+#: traffic model (ops/flush.py FIELD_TRAFFIC) and the sharding specs
+#: (parallel/sharded.py state_specs).  ``AXIS_CLASSIFIED_STATE`` names
+#: the class the partition describes (this module also defines
+#: DagConfig, which is plain static config, not device state).
+AXIS_CLASSIFIED_STATE = "DagState"
+PER_EVENT_FIELDS = ("sp", "op", "creator", "seq", "ts", "mbit",
+                    "la", "fd", "round", "witness", "rr", "cts")
+PER_ROUND_FIELDS = ("wslot", "famous", "sm")
+PER_CREATOR_FIELDS = ("ce", "cnt", "s_off")
+SCALAR_FIELDS = ("n_events", "max_round", "lcr", "e_off", "r_off")
+
+
 def init_state(cfg: DagConfig,
                include_coords: bool = True) -> DagState:
     if cfg.coord8 and not coord8_ok(cfg.s_cap):

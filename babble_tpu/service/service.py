@@ -168,16 +168,41 @@ class Service:
                 shutil.rmtree(self._trace_dirs.pop(0), ignore_errors=True)
             self._profiling = True
             started = False
+            loop = asyncio.get_running_loop()
+            # executor hop: profiler start/stop initialize and
+            # serialize the trace session — measured >10 s for the
+            # first start on a cold CPU backend — and running them
+            # inline stalls the whole gossip loop for that long (the
+            # loop-lag probe's exact failure mode, and the tier-1
+            # socket-timeout flake in test_service_debug_endpoints)
+            start_fut = loop.run_in_executor(
+                None, jax.profiler.start_trace, out_dir
+            )
             try:
-                jax.profiler.start_trace(out_dir)
+                # shield: if THIS handler is cancelled mid-start, the
+                # worker thread still completes start_trace — the
+                # cleanup below must know the session really started
+                await asyncio.shield(start_fut)
                 started = True
                 await asyncio.sleep(seconds)
             finally:
-                # only stop what actually started — a start_trace failure
-                # must not mask itself with 'no trace running' and wedge
-                # _profiling permanently
                 if started:
-                    jax.profiler.stop_trace()
+                    # only stop what actually started — a start_trace
+                    # failure must not mask itself with 'no trace
+                    # running' and wedge _profiling permanently
+                    await loop.run_in_executor(
+                        None, jax.profiler.stop_trace
+                    )
+                else:
+                    # cancelled while the (slow) start was in flight:
+                    # stop the session the moment the worker thread
+                    # finishes starting it, or it would record forever
+                    # and wedge every later /debug/trace
+                    start_fut.add_done_callback(
+                        lambda f: (not f.cancelled()
+                                   and f.exception() is None
+                                   and jax.profiler.stop_trace())
+                    )
                 # same busy-guard pattern as /debug/profile above
                 self._profiling = False  # babble-lint: disable=await-state-race
             body = json.dumps({"trace_dir": out_dir, "seconds": seconds})

@@ -1,0 +1,31 @@
+"""Bad fixture: bytes-model coverage holes (ISSUE 12).
+
+The axis classification misses a field (``sm``), the flush traffic
+model misses another (``fd``) AND carries a stale row for a field the
+state no longer has (``old_fd``) — under-counting and over-counting
+both break the before/after HBM meter (ROADMAP item 4)."""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class MiniState(NamedTuple):
+    la: jnp.ndarray
+    fd: jnp.ndarray
+    sm: jnp.ndarray
+
+
+AXIS_CLASSIFIED_STATE = "MiniState"  # MARK: bytes-model-coverage
+PER_EVENT_FIELDS = ("la", "fd")
+PER_ROUND_FIELDS = ()
+
+FIELD_TRAFFIC = {  # MARK: bytes-model-coverage
+    "la": (("ingest", None),),
+    "old_fd": (("order", None),),
+    "derived:votes": (("fame", None),),
+}
+
+
+def flush_bytes_estimate(cfg, W, k):
+    return FIELD_TRAFFIC

@@ -1,0 +1,30 @@
+"""Good twin: the classification partitions the state exactly and
+every per-event/per-round field owns a traffic row."""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class MiniState(NamedTuple):
+    la: jnp.ndarray
+    fd: jnp.ndarray
+    sm: jnp.ndarray
+    lcr: jnp.ndarray
+
+
+AXIS_CLASSIFIED_STATE = "MiniState"
+PER_EVENT_FIELDS = ("la", "fd")
+PER_ROUND_FIELDS = ("sm",)
+PER_CREATOR_FIELDS = ()
+SCALAR_FIELDS = ("lcr",)
+
+FIELD_TRAFFIC = {
+    "la": (("ingest", None),),
+    "fd": (("ingest", None), ("order", None)),
+    "sm": (("fame", None),),
+}
+
+
+def flush_bytes_estimate(cfg, W, k):
+    return FIELD_TRAFFIC

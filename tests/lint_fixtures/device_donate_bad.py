@@ -1,0 +1,101 @@
+"""Bad fixture: donated buffers read after the call (ISSUE 12).
+
+Donation is a no-op on CPU, so every pattern here runs green in
+tier-1 and crashes on TPU where the buffer is actually invalidated —
+exactly the defect class only the static pass can gate."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step_impl(cfg, state, batch):
+    return state
+
+
+step = jax.jit(_step_impl, static_argnums=(0,), donate_argnums=(1,))
+
+
+def direct_use_after_donate(cfg, batch):
+    state = jnp.zeros((4,))
+    out = step(cfg, state, batch)
+    return out + state  # MARK: donate-use-after-free
+
+
+def _advance(cfg, state, batch):
+    # helper passes its param straight through to the donated slot:
+    # calling it donates the CALLER's buffer (resolved over the
+    # project call graph, like ops/wide.py run_wide_coords)
+    return step(cfg, state, batch)
+
+
+def through_helper(cfg, batch):
+    state = jnp.zeros((4,))
+    out = _advance(cfg, state, batch)
+    return out + state  # MARK: donate-use-after-free
+
+
+def _kernels():
+    # the ops/wide.py _jits shape: locally-jitted programs handed out
+    # through a dict
+    def _absorb_impl(buf, rows):
+        return buf
+
+    absorb = jax.jit(_absorb_impl, donate_argnums=(0,))
+    return dict(absorb=absorb)
+
+
+def through_jit_dict(rows):
+    j = _kernels()
+    buf = jnp.zeros((8,))
+    j["absorb"](buf, rows)  # result dropped: buf is dead now
+    return buf.sum()  # MARK: donate-use-after-free
+
+
+def same_line_self_rebind(cfg, batch):
+    # `state = state._replace(...)` AFTER a donation reads the dead
+    # buffer before the rebind takes effect — the rebind must not
+    # sanitize its own right-hand side
+    state = jnp.zeros((4,))
+    out = step(cfg, state, batch)
+    state = state + 1  # MARK: donate-use-after-free
+    return out, state
+
+
+import functools
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(1,))
+def _absorb_dec(buf, k):
+    return buf
+
+
+def decorator_entry(k):
+    # decorator-form jit entries (@functools.partial(jax.jit, ...))
+    # register exactly like assignment-form ones
+    buf = jnp.zeros((8,))
+    _absorb_dec(buf, k)
+    return buf.sum()  # MARK: donate-use-after-free
+
+
+def loop_without_rebind(cfg, batches):
+    # the second iteration feeds the invalidated buffer straight back
+    # into the donating call — no later-line read exists, only the
+    # loop back-edge
+    state = jnp.zeros((4,))
+    acc = 0
+    for b in batches:
+        acc += step(cfg, state, b)  # MARK: donate-use-after-free
+    return acc
+
+
+def read_in_except_handler(cfg, batch):
+    # the handler runs AFTER the try body partially executed: if
+    # anything raises past the donating call, the handler reads the
+    # dead buffer — except arms are not exclusive with the body
+    state = jnp.zeros((4,))
+    try:
+        out = step(cfg, state, batch)
+        out.block_until_ready()
+    except RuntimeError:
+        out = state * 2  # MARK: donate-use-after-free
+    return out

@@ -1,0 +1,52 @@
+"""Good twin: every donated buffer is rebound from the result — the
+``self.state = step(..., self.state, ...)`` convention of the live
+engine, plus the loop and helper shapes that stay clean."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step_impl(cfg, state, batch):
+    return state
+
+
+step = jax.jit(_step_impl, static_argnums=(0,), donate_argnums=(1,))
+
+
+def rebind_from_result(cfg, batches):
+    state = jnp.zeros((4,))
+    for b in batches:
+        state = step(cfg, state, b)
+    return state
+
+
+def _advance(cfg, state, batch):
+    return step(cfg, state, batch)
+
+
+def helper_result_rebound(cfg, batch):
+    state = jnp.zeros((4,))
+    state = _advance(cfg, state, batch)
+    return state + 1
+
+
+def exclusive_branch_read(cfg, batch, fast):
+    # the kernel-split dispatch shape: the else arm can never run
+    # after the donating if arm, so its read is NOT a use-after-free
+    state = jnp.zeros((4,))
+    if fast:
+        out = step(cfg, state, batch)
+    else:
+        out = state * 2
+    return out
+
+
+class Engine:
+    def __init__(self):
+        self.state = jnp.zeros((4,))
+
+    def flush(self, cfg, batch):
+        # donate + rebind in one statement: the donated buffer is
+        # never observable after the call
+        self.state = step(cfg, self.state, batch)
+        return self.state

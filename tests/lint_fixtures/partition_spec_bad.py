@@ -1,0 +1,30 @@
+"""Bad fixture: device-state coverage holes (ISSUE 12).
+
+(a) a NamedTuple field with no partition rule in the *_specs builder —
+the sharded path would silently drop/replicate the new state;
+(b) a static-index sentinel-row restore — under SPMD the lowered
+dynamic-update-slice start clamps per shard and the write corrupts
+the last row of every earlier shard (ops/state.py set_sentinel)."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class MiniState(NamedTuple):
+    la: jnp.ndarray
+    fd: jnp.ndarray
+    frontier: jnp.ndarray  # new field: no rule below
+
+
+def state_specs():
+    return MiniState(  # MARK: partition-spec-coverage
+        la=P("ev", "p"),
+        fd=P("ev", "p"),
+    )
+
+
+def restore_sentinel(cfg, la):
+    return la.at[cfg.e_cap].set(-1)  # MARK: partition-spec-coverage

@@ -1,0 +1,45 @@
+"""Good twin: every field carries a spec, sentinel restores are
+elementwise selects, and scatter/row-0/traced-index writes stay in
+scope-free territory."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class MiniState(NamedTuple):
+    la: jnp.ndarray
+    fd: jnp.ndarray
+    frontier: jnp.ndarray
+
+
+def state_specs():
+    ev = P("ev")
+    return MiniState(
+        la=P("ev", "p"),
+        fd=P("ev", "p"),
+        frontier=ev,
+    )
+
+
+def star_specs():
+    # starred construction is "no information", never a finding
+    specs = [P("ev", "p") for _ in MiniState._fields]
+    return MiniState(*specs)
+
+
+def restore_sentinel(cfg, la):
+    # the SPMD-safe idiom: elementwise select over an iota mask
+    mask = (jnp.arange(cfg.e_cap + 1) == cfg.e_cap)[:, None]
+    return jnp.where(mask, -1, la)
+
+
+def scatter_is_fine(la, slots, rows):
+    # traced-index scatters lower to scatter ops, not clamped slices
+    return la.at[slots].set(rows)
+
+
+def row_zero_is_fine(table, pos0):
+    return table.at[0].set(pos0)

@@ -1,0 +1,23 @@
+"""Bad fixture: runtime-varying data fed straight into static_argnums
+slots (ISSUE 12) — every distinct pending-queue depth or schedule
+height traces and compiles a FRESH program, the per-flush retrace
+churn the shape buckets (ops/flush.bucket_w, ops/state.bucket,
+_padded_schedule) exist to prevent."""
+
+import jax
+
+
+def _flush_impl(cfg, k, state):
+    return state
+
+
+flush = jax.jit(_flush_impl, static_argnums=(0, 1), donate_argnums=(2,))
+
+
+class Engine:
+    def drain(self, cfg):
+        k = len(self.pending)
+        self.state = flush(cfg, k, self.state)  # MARK: recompile-hazard
+
+    def drain_sched(self, cfg, sched):
+        self.state = flush(cfg, sched.shape[0], self.state)  # MARK: recompile-hazard

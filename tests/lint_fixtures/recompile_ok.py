@@ -1,0 +1,30 @@
+"""Good twin: statics route through bucketing helpers (or select
+between constants — two-way bucketing), so a flush stream shares a
+small closed set of compiled programs."""
+
+import jax
+
+
+def _flush_impl(cfg, k, state):
+    return state
+
+
+flush = jax.jit(_flush_impl, static_argnums=(0, 1), donate_argnums=(2,))
+
+
+def bucket(x, minimum=8):
+    v = max(x, minimum)
+    return 1 << (v - 1).bit_length()
+
+
+class Engine:
+    def drain(self, cfg):
+        kpad = bucket(len(self.pending))
+        self.state = flush(cfg, kpad, self.state)
+
+    def drain_mode(self, cfg):
+        # selecting between CONSTANTS on a varying test is two-way
+        # bucketing, not a hazard (the engine's fd_mode dispatch)
+        k = len(self.pending)
+        mode = "full" if k > 512 else "incremental"
+        self.state = flush(cfg, mode, self.state)

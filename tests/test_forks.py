@@ -333,3 +333,37 @@ def test_laggard_chains_block_unsafe_eviction():
     for s in range(len(rolled.dag.events)):
         x = rolled.dag.events[s].hex()
         assert rolled.round(x) == plain.round(x), x
+
+
+def test_fork_pipeline_sentinel_rows_stay_sentinel():
+    """Regression for the ISSUE-12 ``partition-spec-coverage`` findings:
+    the fork kernels restored their sentinel/dump rows with
+    static-index ``.at[cap].set()`` writes — which lower to
+    dynamic-update-slices whose per-shard start clamps under SPMD
+    partitioning and corrupts earlier shards once the pipeline runs
+    through make_sharded_fork_step (ops/state.py set_sentinel
+    docstring; observed on ce/cnt for the honest pipeline).  The
+    rewritten elementwise restores must leave every sentinel row
+    exactly sentinel-valued; output parity with the oracle is pinned
+    by the differential tests above."""
+    import jax
+    import numpy as np
+
+    from babble_tpu.ops import forks as F
+
+    dag = random_byzantine_dag(6, 220, seed=4, fork_rate=0.1)
+    fh = ForkHashgraph(dag.participants, k=2)
+    for ev in dag.events:
+        fh.insert_event(ev.clone())
+    cfg, _ = fh._run()
+    batch = fh.dag.build_batch(cfg)
+
+    la = np.asarray(jax.jit(lambda b: F._la_scan(cfg, b))(batch))
+    fd = np.asarray(jax.jit(lambda b: F._fd_reverse(cfg, b))(batch))
+    assert (la[cfg.e_cap] == -1).all()
+    assert (fd[cfg.e_cap] == np.iinfo(np.int32).max).all()
+
+    out = F.fork_pipeline(cfg, batch)
+    assert int(np.asarray(out.round)[cfg.e_cap]) == -1
+    assert not bool(np.asarray(out.witness)[cfg.e_cap])
+    assert (np.asarray(out.wslot)[cfg.r_cap] == -1).all()

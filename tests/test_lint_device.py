@@ -1,0 +1,362 @@
+"""Tier-1 gate for the device-plane lint family (ISSUE 12).
+
+Four rules — donate-use-after-free, recompile-hazard,
+partition-spec-coverage, bytes-model-coverage — checked three ways:
+each fires on its bad fixture and stays silent on the good twin, the
+repo itself is clean with ZERO suppressions (the door the family
+closes stays closed), and the acceptance-criterion property is
+demonstrated end to end: adding a DagState-style field without a
+partition rule re-fires ``partition-spec-coverage`` THROUGH the
+``--cache`` layer (the edit invalidates the whole-run cache).
+
+Stdlib-only, like every lint gate — the analysis package must run
+where jax is absent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from babble_tpu.analysis import ALL_RULES, RULE_NAMES, check_file, run_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "babble_tpu")
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+DEVICE_RULES = ("donate-use-after-free", "recompile-hazard",
+                "partition-spec-coverage", "bytes-model-coverage")
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _marked_lines(path, rule):
+    with open(path, encoding="utf-8") as f:
+        return {
+            i for i, line in enumerate(f, start=1)
+            if f"MARK: {rule}" in line
+        }
+
+
+def _found_lines(findings, rule):
+    return {f.line for f in findings if f.rule == rule}
+
+
+# ----------------------------------------------------------------------
+# fixtures
+
+
+def test_donate_fixture_findings():
+    """Reads of a donated buffer are flagged — after a direct jit-entry
+    call, through a helper that donates its parameter (call-graph
+    resolution), through a _jits-style dict of locally-jitted programs,
+    in a same-line self-rebind (`state = state + 1` reads the dead
+    buffer before the rebind lands), after a decorator-form entry
+    (`@functools.partial(jax.jit, donate_argnums=...)`), on the loop
+    back-edge (donated in a loop, never rebound — the next iteration
+    feeds the dead buffer back in), and in an except handler (which
+    runs AFTER the body partially executed, so it is not exclusive
+    with the donating try body).  Rebind-from-result shapes AND reads
+    in the mutually-exclusive else arm of a donating if (the
+    kernel-split dispatch shape) stay clean."""
+    path = _fixture("device_donate_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "donate-use-after-free") == (
+        _marked_lines(path, "donate-use-after-free")
+    ), [f.format() for f in findings]
+    assert len(findings) == 7, [f.format() for f in findings]
+
+    ok = check_file(_fixture("device_donate_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_recompile_fixture_findings():
+    """len()/.shape fed into a static_argnums slot is per-flush retrace
+    churn; bucket-helper routing and constant-selecting IfExps (two-way
+    bucketing) stay clean."""
+    path = _fixture("recompile_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "recompile-hazard") == (
+        _marked_lines(path, "recompile-hazard")
+    ), [f.format() for f in findings]
+    assert len(findings) == 2, [f.format() for f in findings]
+
+    ok = check_file(_fixture("recompile_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_partition_spec_fixture_findings():
+    """A NamedTuple field with no rule in the *_specs builder and a
+    static sentinel-row write both fire; complete specs, set_sentinel
+    selects, traced scatters and row-0 writes stay clean."""
+    path = _fixture("partition_spec_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "partition-spec-coverage") == (
+        _marked_lines(path, "partition-spec-coverage")
+    ), [f.format() for f in findings]
+    assert len(findings) == 2, [f.format() for f in findings]
+
+    ok = check_file(_fixture("partition_spec_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_bytes_model_fixture_findings():
+    """An unclassified state field, a field missing from the flush
+    traffic model AND a stale traffic row (a field the state no longer
+    has — an orphan that would silently inflate every estimate) all
+    fire; the exact-partition twin stays clean."""
+    path = _fixture("bytes_model_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "bytes-model-coverage") == (
+        _marked_lines(path, "bytes-model-coverage")
+    ), [f.format() for f in findings]
+    assert len(findings) == 3, [f.format() for f in findings]
+    assert any("old_fd" in f.message for f in findings)
+
+    ok = check_file(_fixture("bytes_model_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+# ----------------------------------------------------------------------
+# the repo gate: clean with zero suppressions
+
+
+def test_device_rules_clean_project_wide():
+    """ops/ and parallel/ pass the whole family with ZERO suppressions
+    — on landing the partition-spec rule surfaced six live static
+    sentinel writes in ops/forks.py (fixed with set_sentinel,
+    regression-tested in tests/test_forks.py); nothing may regress
+    behind a waiver."""
+    findings = run_paths([PKG], ALL_RULES, known_rules=RULE_NAMES,
+                         include_suppressed=True)
+    device = [f for f in findings if f.rule in DEVICE_RULES]
+    assert device == [], [f.format() for f in device]
+
+
+def test_donate_through_resolves_the_wide_pipeline():
+    """The call-graph half of the donate rule earns its keep on
+    ops/wide.py: run_wide_coords donates its caller's state and both
+    coordinate block stacks (through the _jits dict programs), so a
+    caller that reads them without rebinding is flagged at ITS site."""
+    from babble_tpu.analysis.device import device_index
+    from babble_tpu.analysis.engine import _load_context, iter_python_files
+    from babble_tpu.analysis.graph import ProjectContext
+
+    ctxs = []
+    for p in iter_python_files([PKG]):
+        ctx, _ = _load_context(p)
+        if ctx is not None:
+            ctxs.append(ctx)
+    project = ProjectContext([(c.path, c.tree) for c in ctxs])
+    idx = device_index(project)
+    through = idx.donate_through
+    assert through.get("babble_tpu.ops.wide:run_wide_coords") == (1, 3, 4)
+    assert through.get("babble_tpu.ops.wide:run_wide_rounds") == (1,)
+    assert through.get("babble_tpu.ops.flush:probed_flush") == (3,)
+    # the _jits dict factory resolved with its donating programs
+    jits = idx.dict_factories["babble_tpu.ops.wide:_jits"]
+    assert jits["write_batch"].donate == (0,)
+    assert jits["compact_block"].donate == (0,)
+
+
+# ----------------------------------------------------------------------
+# the acceptance-criterion property, through the --cache layer
+
+_MINI_STATE = '''\
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class MiniState(NamedTuple):
+    la: jnp.ndarray
+    fd: jnp.ndarray
+'''
+
+_MINI_SPECS = '''\
+from jax.sharding import PartitionSpec as P
+
+from ministate import MiniState
+
+
+def state_specs():
+    return MiniState(la=P("ev", "p"), fd=P("ev", "p"))
+'''
+
+
+def test_state_field_edit_refires_partition_coverage_through_cache(tmp_path):
+    """The tentpole property end to end: a tree whose specs cover every
+    state field is clean (and cached); ADDING a field to the NamedTuple
+    — the exact shape of ROADMAP item 1's `DagState.sm` requirement —
+    invalidates the cache and fails lint until the specs carry a rule
+    for it."""
+    from babble_tpu.analysis import run_paths_cached
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "ministate.py").write_text(_MINI_STATE, encoding="utf-8")
+    (src / "specs.py").write_text(_MINI_SPECS, encoding="utf-8")
+    cache_file = str(tmp_path / ".babble_lint_cache")
+
+    clean, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                  known_rules=RULE_NAMES)
+    assert hit is False and clean == [], [f.format() for f in clean]
+    again, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                  known_rules=RULE_NAMES)
+    assert hit is True and again == []
+
+    # the new field lands in the state module ONLY — the specs file is
+    # untouched, which is exactly why a per-file cache would be unsound
+    # and the whole-run cache must recompute
+    with open(src / "ministate.py", "a", encoding="utf-8") as f:
+        f.write("    sm: jnp.ndarray\n")
+    after, hit = run_paths_cached([str(src)], ALL_RULES, cache_file,
+                                  known_rules=RULE_NAMES)
+    assert hit is False
+    assert [f.rule for f in after] == ["partition-spec-coverage"], [
+        f.format() for f in after
+    ]
+    assert after[0].path.endswith("specs.py")
+    assert "sm" in after[0].message
+
+
+def test_real_dagstate_specs_cover_every_field():
+    """parallel/sharded.py state_specs names every DagState field right
+    now (the rule checks this statically; this pins it at runtime too,
+    epochs' `sm` included — ROADMAP item 1)."""
+    from babble_tpu.ops.state import (
+        AXIS_CLASSIFIED_STATE,
+        DagState,
+        PER_CREATOR_FIELDS,
+        PER_EVENT_FIELDS,
+        PER_ROUND_FIELDS,
+        SCALAR_FIELDS,
+    )
+    from babble_tpu.parallel.sharded import state_specs
+
+    specs = state_specs()
+    assert len(specs) == len(DagState._fields)
+    # the axis classification partitions the fields exactly
+    union = (PER_EVENT_FIELDS + PER_ROUND_FIELDS + PER_CREATOR_FIELDS
+             + SCALAR_FIELDS)
+    assert sorted(union) == sorted(DagState._fields)
+    assert AXIS_CLASSIFIED_STATE == "DagState"
+
+
+_MINI_STATE_FULL = '''\
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class MiniState(NamedTuple):
+    la: jnp.ndarray
+    cnt: jnp.ndarray
+
+
+AXIS_CLASSIFIED_STATE = "MiniState"
+PER_EVENT_FIELDS = ("la",)
+PER_ROUND_FIELDS = ()
+PER_CREATOR_FIELDS = ("cnt",)
+SCALAR_FIELDS = ()
+'''
+
+_MINI_TRAFFIC = '''\
+from ministate import PER_EVENT_FIELDS, PER_ROUND_FIELDS
+
+FIELD_TRAFFIC = {
+    "la": (("ingest", None),),
+    "cnt": (("ingest", None),),
+}
+
+
+def flush_bytes_estimate(cfg, W, k):
+    return FIELD_TRAFFIC
+'''
+
+
+def test_voluntary_per_creator_traffic_row_is_not_stale(tmp_path):
+    """The legal-key universe is ALL axis tuples of the state module —
+    resolved through whichever required tuple the traffic module
+    imports — so voluntarily modeling a per-creator tensor (cnt) is
+    never misreported as a stale row even though the traffic module
+    imports only the per-event/per-round tuples (the real
+    ops/flush.py shape)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "ministate.py").write_text(_MINI_STATE_FULL, encoding="utf-8")
+    (src / "traffic.py").write_text(_MINI_TRAFFIC, encoding="utf-8")
+    findings = run_paths([str(src)], ALL_RULES, known_rules=RULE_NAMES)
+    assert findings == [], [f.format() for f in findings]
+
+
+# ----------------------------------------------------------------------
+# --sarif (CI annotation surface)
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "babble_tpu.analysis", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_sarif_schema_roundtrips():
+    """--sarif emits one SARIF 2.1.0 document carrying the same finding
+    stream as --json: every (path, line, rule, suppressed) in the
+    in-process run appears as a result, suppressed findings as level
+    `note` with an inSource suppression, and the driver catalogs every
+    rule.  Exit status still counts live findings only."""
+    proc = _run_cli("--sarif", FIXTURES)
+    assert proc.returncode == 1, proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    assert run["tool"]["driver"]["name"] == "babble-lint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {r.name for r in ALL_RULES} <= rule_ids
+
+    got = set()
+    for res in run["results"]:
+        loc = res["locations"][0]["physicalLocation"]
+        suppressed = bool(res.get("suppressions"))
+        assert res["level"] == ("note" if suppressed else "warning")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["startColumn"] >= 1
+        got.add((loc["artifactLocation"]["uri"],
+                 loc["region"]["startLine"], res["ruleId"], suppressed))
+
+    expected = {
+        (f.path.replace(os.sep, "/"), f.line, f.rule, f.suppressed)
+        for f in run_paths([FIXTURES], ALL_RULES, known_rules=RULE_NAMES,
+                           include_suppressed=True)
+    }
+    assert got == expected
+
+
+def test_json_and_sarif_are_mutually_exclusive():
+    """Each flag claims stdout whole: silently preferring one would
+    hand a SARIF upload step JSONL with a passing exit code.  Usage
+    error instead."""
+    proc = _run_cli("--json", "--sarif", FIXTURES)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "mutually exclusive" in proc.stderr
+
+
+def test_sarif_clean_tree_exits_zero_with_only_waived_notes():
+    """A clean tree exits 0; its SARIF results are exactly the
+    sanctioned in-source waivers (level note + suppression object), so
+    an annotator shows the waiver inventory without failing CI."""
+    proc = _run_cli("--sarif", "babble_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    for res in doc["runs"][0]["results"]:
+        assert res["level"] == "note", res
+        assert res["suppressions"] == [{"kind": "inSource"}], res

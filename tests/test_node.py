@@ -267,8 +267,13 @@ def test_service_debug_endpoints():
         base = f"http://{svc.bind_addr}"
         loop = asyncio.get_running_loop()
 
+        # generous socket timeout: the FIRST jax.profiler.start_trace
+        # initializes the profiler session, measured >12 s on a cold
+        # CPU backend in a contended container — the request is slow by
+        # nature (the service runs it off-loop so the node stays live;
+        # a 10 s timeout here was the tier-1 flake)
         def get(url):
-            with urllib.request.urlopen(url, timeout=10) as r:
+            with urllib.request.urlopen(url, timeout=120) as r:
                 return r.status, r.read()
 
         st, body = await loop.run_in_executor(None, get, base + "/Stats")

@@ -60,13 +60,16 @@ def test_rule_catalog_well_formed():
         assert " " not in r.name, f"rule name {r.name!r} is not a slug"
         assert r.description, f"rule {r.name} has no description"
     # the ISSUE-1 rule families, the ISSUE-2 blocking-call rule, the
-    # ISSUE-3 chaos-reproducibility rule, and the ISSUE-4 project-wide
-    # flow-aware rules
+    # ISSUE-3 chaos-reproducibility rule, the ISSUE-4 project-wide
+    # flow-aware rules, and the ISSUE-12 device-plane family
     assert {"jit-traced-branch", "jit-host-sync", "jit-unhashable-static",
             "await-state-race", "asyncio-blocking-call",
             "drain-before-validate", "falsy-or-fallback",
             "chaos-unseeded-random", "consensus-nondeterminism",
-            "held-guard-escape", "wal-before-gossip"} <= set(names)
+            "held-guard-escape", "wal-before-gossip",
+            "donate-use-after-free", "recompile-hazard",
+            "partition-spec-coverage",
+            "bytes-model-coverage"} <= set(names)
 
 
 def test_every_suppression_in_tree_names_a_rule():
@@ -447,7 +450,9 @@ def test_cli_exits_nonzero_with_locations_on_fixtures():
                  "asyncio-blocking-call", "drain-before-validate",
                  "falsy-or-fallback", "chaos-unseeded-random",
                  "consensus-nondeterminism", "held-guard-escape",
-                 "stale-suppression", "wal-before-gossip"):
+                 "stale-suppression", "wal-before-gossip",
+                 "donate-use-after-free", "recompile-hazard",
+                 "partition-spec-coverage", "bytes-model-coverage"):
         assert rule in proc.stdout, (rule, proc.stdout)
     import re
 
@@ -573,7 +578,13 @@ def test_cache_hit_skips_analysis_and_edit_invalidates(tmp_path):
 
 def test_cached_run_is_fast_enough(tmp_path):
     """Acceptance criterion: the cached project-wide pass costs <= 25%
-    of the cold pass (in practice it is a stat sweep, ~100x cheaper)."""
+    of the cold pass (in practice it is a stat sweep, ~100x cheaper).
+    The rule set here is ALL_RULES, so every family added since —
+    including the ISSUE-12 device plane, whose jit registry and
+    donate-through fixpoint walk the whole call graph — rides the same
+    budget: new cross-module analyses may grow the COLD pass but can
+    never regress the cached one, which is what tier-1 pays per verify
+    run."""
     import time
 
     from babble_tpu.analysis import run_paths_cached
