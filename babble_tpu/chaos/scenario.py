@@ -206,7 +206,7 @@ class ScenarioRunner:
 
     def __init__(self, scenario: Scenario, seed: Optional[int] = None,
                  consensus_every: int = 6, kernel_class: str = "auto",
-                 _twin: bool = False):
+                 diet: bool = True, _twin: bool = False):
         #: this run IS a drift-free twin (skew_robust_order): collect
         #: committed keys, never recurse into another twin
         self._twin = _twin
@@ -216,6 +216,10 @@ class ScenarioRunner:
         #: under "latency" and "throughput" and asserts bit-identical
         #: fingerprints
         self.kernel_class = kernel_class
+        #: kernel working-set diet pin (ROADMAP item 4): False runs the
+        #: pre-diet kernels (f32 vote tallies, full-height fd scans) —
+        #: the fingerprint-parity suite runs both and asserts identity
+        self.diet = diet
         self.seed = scenario.seed if seed is None else seed
         self.consensus_every = consensus_every
 
@@ -238,7 +242,8 @@ class ScenarioRunner:
             twin = ScenarioRunner(
                 _Scenario.from_dict(d), seed=self.seed,
                 consensus_every=self.consensus_every,
-                kernel_class=self.kernel_class, _twin=True,
+                kernel_class=self.kernel_class, diet=self.diet,
+                _twin=True,
             ).run()
             result.noskew_committed = dict(twin.committed)
             result.noskew_keys = dict(twin.committed_keys)
@@ -339,6 +344,8 @@ class ScenarioRunner:
             if sc.inactive_rounds is not None:
                 conf.inactive_rounds = sc.inactive_rounds
             conf.kernel_class = self.kernel_class
+            conf.packed_votes = self.diet
+            conf.frontier = self.diet
             conf.byzantine = (sc.engine == "byzantine")
             # flight stays ON (invariant violations attach its dumps);
             # lineage OFF — nothing scrapes /debug/lineage in the
@@ -788,11 +795,13 @@ class ScenarioRunner:
 
 def run_scenario(scenario: Scenario,
                  seed: Optional[int] = None,
-                 kernel_class: str = "auto") -> ScenarioResult:
+                 kernel_class: str = "auto",
+                 diet: bool = True) -> ScenarioResult:
     """One deterministic in-memory run; result carries the invariant
-    report (``result.report.ok``)."""
+    report (``result.report.ok``).  ``diet=False`` pins the pre-diet
+    kernels (fingerprint-parity differentials, ROADMAP item 4)."""
     return ScenarioRunner(scenario, seed=seed,
-                          kernel_class=kernel_class).run()
+                          kernel_class=kernel_class, diet=diet).run()
 
 
 # ----------------------------------------------------------------------

@@ -82,6 +82,11 @@ class TpuHashgraph:
     kernel_class = "throughput"
     last_kernel_class: Optional[str] = None
     flush_fallbacks = 0
+    # kernel working-set diet (ROADMAP item 4) defaults for subclasses
+    # that allocate their own state: frontier sizing only runs on the
+    # fused latency path, but the mirrors must exist
+    frontier = True
+    _frontier_cache = 0
     #: attribution plane (ISSUE 11): per-flush HBM bytes-touched
     #: estimate ({"ingest","fame","order","total"}, ops/flush.py) and
     #: the per-phase wall timings of the last probed flush.  Read by
@@ -120,14 +125,31 @@ class TpuHashgraph:
         ts32: bool = False,
         kernel_class: str = "auto",
         inactive_rounds: Optional[int] = 32,
+        packed_votes: bool = True,
+        frontier: bool = True,
     ):
         n = len(participants)
         self.participants = participants
         self.commit_callback = commit_callback
         self.dag = HostDag(participants, verify_signatures=verify_signatures)
+        # kernel working-set diet (ROADMAP item 4): packed_votes rides
+        # the DagConfig (it selects kernel math inside the compiled
+        # programs), frontier is engine policy (it only sizes the F
+        # bucket the order phase scans).  Both are bit-parity-preserving
+        # — False pins the pre-diet kernels for differential tests and
+        # the bench's before/after arms.
         self.cfg = DagConfig(n=n, e_cap=e_cap, s_cap=s_cap, r_cap=r_cap,
-                             ts32=ts32)
+                             ts32=ts32, packed=packed_votes)
         self.state: DagState = init_state(self.cfg)
+        self.frontier = frontier
+        # host mirror of the reception frontier: a monotone LOWER bound
+        # on the first live slot with rr undecided (rr values are
+        # sticky, so last flush's first-undecided row can only move up;
+        # epoch transitions reset decisions and reset this to 0).  The
+        # kernel derives the exact slice offset in-device — the mirror
+        # only sizes the static F bucket, and under-counting it is safe
+        # (a bigger slice), while over-counting would skip receptions.
+        self._frontier_cache = 0
 
         # Streaming incremental engine (ROADMAP item 3):
         # - finality_gate: witness-set finality (ops/wide.py complete=False
@@ -469,6 +491,12 @@ class TpuHashgraph:
         base = self.dag.slot_base
         ne = self.dag.n_events - base          # live rows
         self._lcr_cache = int(self.state.lcr)
+        # refresh the reception-frontier mirror (kernel diet): first
+        # live row still undecided.  rr assignments are sticky, so this
+        # is a monotone lower bound for every later flush — exactly the
+        # safety the F bucket needs (see _frontier_f).
+        und = rr[:ne] < 0
+        self._frontier_cache = int(np.argmax(und)) if und.any() else int(ne)
         new_slots = [
             s for s in range(ne)
             if rr[s] >= 0 and (base + s) not in self._received
@@ -638,6 +666,21 @@ class TpuHashgraph:
         self._latency_w = w
         return True
 
+    def _frontier_f(self) -> int:
+        """Static frontier bucket for this flush (kernel working-set
+        diet): a power-of-two cover of the live frontier height — every
+        event row from the first undecided slot (host lower-bound
+        mirror) through the window top, pending batch included.  The
+        frontier=False pin (and any height past the last bucket)
+        returns full height e1, the pre-diet behavior."""
+        e1 = self.cfg.e_cap + 1
+        if not self.frontier:
+            return e1
+        live = self.dag.n_events - self.dag.slot_base
+        f = flush_ops.bucket_f(live - self._frontier_cache, e1)
+        self._last_frontier_f = f
+        return f
+
     def _flush_live(self) -> List[Event]:
         """One fused latency flush: build the (possibly empty) bucketed
         batch, run live_flush with donated state (AOT executable when
@@ -646,20 +689,27 @@ class TpuHashgraph:
         w = self._latency_w
         k_pending = len(self.dag.pending)
         batch, _ = self.build_batch()
-        key = (w, self.finality_gate, batch.sp.shape[0]) + batch.sched.shape
+        # the frontier bucket must be sized AFTER build_batch: its
+        # _ensure_capacity may have grown e_cap, and bucket_f clamps
+        # against e1 — sized before growth, a growth flush could pick
+        # an F below the live undecided span and silently skip
+        # receptions (the exactly-once property cuts both ways)
+        f = self._frontier_f()
+        key = (w, f, self.finality_gate, batch.sp.shape[0]) \
+            + batch.sched.shape
         exe = self._aot.get(key)
         self._last_phase_timings = None
         if self.phase_probe:
             # three timed dispatches, bit-identical to the fused launch
             # (same impls, same order) — the per-phase wall meter
             self.state, self._last_phase_timings = flush_ops.probed_flush(
-                self.cfg, w, self.finality_gate, self.state, batch
+                self.cfg, w, f, self.finality_gate, self.state, batch
             )
         elif exe is not None:
             self.state = exe(self.state, batch)
         else:
             self.state = flush_ops.live_flush(
-                self.cfg, w, self.finality_gate, self.state, batch
+                self.cfg, w, f, self.finality_gate, self.state, batch
             )
             if self._aot_dir is not None and key not in self._aot_recorded:
                 # record the shape so the next restart can AOT-compile it
@@ -669,7 +719,7 @@ class TpuHashgraph:
                 self._aot_recorded.add(key)
                 aot_ops.record_shape(self._aot_dir, self.cfg, key)
         self.last_flush_bytes = flush_ops.flush_bytes_estimate(
-            self.cfg, w, k_pending
+            self.cfg, w, k_pending, f
         )
         self._view = {}
         lcr_pre = self._lcr_cache
@@ -854,6 +904,10 @@ class TpuHashgraph:
             self._view = {}
         self._max_round_cache = int(self.state.max_round)
         self._lcr_cache = int(self.state.lcr)
+        # the reset wiped rr above the boundary: held events are
+        # undecided again, so the frontier mirror must drop back to the
+        # conservative floor (it re-tightens at the next commit pass)
+        self._frontier_cache = 0
         self.epoch += 1
         self.membership_log.append({
             "epoch": self.epoch,
@@ -962,6 +1016,9 @@ class TpuHashgraph:
         )
         self._received = {g for g in self._received if g >= base + k}
         self._r_off += dr
+        # the evicted prefix is all received, so the frontier shifts
+        # with the slots (never below row 0)
+        self._frontier_cache = max(self._frontier_cache - k, 0)
         self._view = {}
         self._evicted_creators_cache = sum(
             1 for c in self.dag.chains if len(c) and not c.window

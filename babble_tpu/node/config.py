@@ -107,6 +107,18 @@ class Config:
     # phases for bulk ingest; "latency"/"throughput" pin one path
     # (parity tests, benches).  Wide/byzantine engines ignore this.
     kernel_class: str = "auto"
+    # ---- kernel working-set diet (ROADMAP item 4) ----
+    # Bit-packed votes: the fused latency kernel's see/strongly-see/
+    # vote tallies run over 8:1 uint8 lanes with popcount
+    # supermajorities instead of f32 einsums.  Bit-parity-preserving;
+    # False pins the pre-diet f32 tally (differential tests, the
+    # bench's before/after arm).
+    packed_votes: bool = True
+    # Event-axis frontier: the windowed order phase scans only the
+    # F-row frontier slice of fd (power-of-two-bucketed live frontier
+    # height) instead of the full [E+1, N] column per round.  False
+    # pins full-height scans.
+    frontier: bool = True
     # AOT compile cache: a directory makes the node record compiled
     # live-flush shapes (babble_aot_manifest.json) and pre-compile them
     # at boot against jax's persistent compilation cache, so a restart

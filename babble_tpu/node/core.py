@@ -73,9 +73,16 @@ class Core:
         inactive_rounds: Optional[int] = 32,
         lineage=None,
         phase_probe: bool = False,
+        packed_votes: bool = True,
+        frontier: bool = True,
     ):
         self.id = core_id
         self.kernel_class = kernel_class
+        # kernel working-set diet (ROADMAP item 4): both knobs are
+        # bit-parity-preserving pins — packed popcount vote tallies and
+        # the event-axis frontier bucket on the fused latency kernel
+        self.packed_votes = packed_votes
+        self.frontier = frontier
         # attribution plane (ISSUE 11): the owning node's commit-lineage
         # recorder.  Hooks live at the two places only the Core can see
         # — the mint (tx -> event hash join pivot) and the peer insert.
@@ -171,6 +178,8 @@ class Core:
                 # per-creator eviction (ISSUE 8): a peer silent for
                 # this many decided rounds stops pinning the window
                 inactive_rounds=inactive_rounds,
+                packed_votes=packed_votes,
+                frontier=frontier,
             )
         self.byzantine = byzantine
         self._apply_live_engine_policy()
@@ -457,6 +466,16 @@ class Core:
             self.hg.finality_gate = True
             self.hg.kernel_class = self.kernel_class
             self.hg.phase_probe = self.phase_probe
+            # diet pins (kernel working-set diet): an adopted snapshot
+            # carries the peer's packed flag in its cfg — override with
+            # this core's policy (bit-parity either way, but the
+            # compiled-program universe should follow local config)
+            self.hg.frontier = self.frontier
+            if self.hg.cfg.packed != self.packed_votes:
+                self.hg.cfg = self.hg.cfg._replace(
+                    packed=self.packed_votes
+                )
+                self.hg._aot = {}
 
     def _rebind_engine_registry(self) -> None:
         """Point the current engine's instruments at this core's

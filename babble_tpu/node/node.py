@@ -212,6 +212,8 @@ class Node:
             inactive_rounds=conf.inactive_rounds,
             lineage=self.lineage,
             phase_probe=conf.phase_probe,
+            packed_votes=getattr(conf, "packed_votes", True),
+            frontier=getattr(conf, "frontier", True),
         )
         if self.core.probing:
             self.flight.note("probe_armed",

@@ -42,8 +42,10 @@ from .state import DagConfig, init_state
 
 #: bump when a change to the flush/ingest/fame/order kernels makes old
 #: manifest entries meaningless (the persistent XLA cache keys on HLO
-#: and self-invalidates; this guards OUR shape replay layer)
-ENGINE_CACHE_VERSION = "8.0"
+#: and self-invalidates; this guards OUR shape replay layer).
+#: 9.0: kernel working-set diet — live-flush keys grew the frontier
+#: bucket F ((W, F, gate, kpad, t, b)) and DagConfig the packed flag.
+ENGINE_CACHE_VERSION = "9.0"
 
 _MANIFEST = "babble_aot_manifest.json"
 
@@ -216,8 +218,9 @@ def record_wide_cfg(cache_dir: str, cfg: DagConfig, n_blocks: int) -> None:
 
 #: shapes compiled when the manifest has nothing for this cfg yet: the
 #: smallest gossip buckets (an 8-event flush with 1-4 topological
-#: levels under the first W bucket) — the programs a fresh live fleet
-#: hits within its first heartbeats
+#: levels under the first W bucket and the smallest frontier bucket —
+#: a fresh engine's frontier height starts under F_MIN) — the programs
+#: a fresh live fleet hits within its first heartbeats
 _DEFAULT_SHAPES: Tuple[Tuple[int, Tuple[int, int]], ...] = (
     (8, (1, 4)),
     (8, (2, 4)),
@@ -288,19 +291,25 @@ def prewarm_engine(engine, cache_dir: str,
             from_manifest += 1
     if not keys and defaults:
         w0 = flush_ops.bucket_w(1, cfg.r_cap)
+        # a frontier-off engine's live keys always carry f = e1 —
+        # default shapes must match or boot compiles programs the
+        # first heartbeats can never hit
+        f0 = (flush_ops.bucket_f(1, cfg.e_cap + 1)
+              if getattr(engine, "frontier", True) else cfg.e_cap + 1)
         if w0:
-            keys = [(w0, gate, kpad) + tb for kpad, tb in _DEFAULT_SHAPES]
+            keys = [(w0, f0, gate, kpad) + tb
+                    for kpad, tb in _DEFAULT_SHAPES]
 
     state_sds = jax.eval_shape(lambda: init_state(cfg))
     compiled = 0
     for key in keys:
         if key in engine._aot:
             continue
-        w, kgate, kpad, t, b = key
-        if w > cfg.r_cap or kgate != gate:
+        w, f, kgate, kpad, t, b = key
+        if w > cfg.r_cap or f > cfg.e_cap + 1 or kgate != gate:
             continue
         lowered = flush_ops.live_flush.lower(
-            cfg, int(w), bool(kgate), state_sds,
+            cfg, int(w), int(f), bool(kgate), state_sds,
             _batch_struct(int(kpad), (int(t), int(b))),
         )
         engine._aot[key] = lowered.compile()

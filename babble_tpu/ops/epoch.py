@@ -34,7 +34,7 @@ from typing import Dict
 
 import numpy as np
 
-from .state import DagConfig, DagState, FAME_UNDEFINED
+from .state import DagConfig, DagState, FAME_UNDEFINED, repack_round_bits_np
 
 I32 = np.int32
 
@@ -127,4 +127,13 @@ def epoch_transition_arrays(
         & (a["seq"] >= 0)
     mr = a["round"][live].max() if live.any() else -1
     a["max_round"] = np.asarray(int(mr), I32)
+
+    # packed witness bitplanes (kernel diet): recompute from the
+    # re-shaped wide tensors — a join widens the participant axis, so
+    # the uint8 LANE count re-buckets (ceil(n/8)) with it, and the
+    # boundary resets above already cleared the famous/wslot rows the
+    # planes derive from
+    a["mbr"], a["fmr"] = repack_round_bits_np(
+        new, a["wslot"], a["famous"], a["mbit"]
+    )
     return a

@@ -42,6 +42,7 @@ from .state import (
     DagState,
     I32,
     head_round_min_math,
+    repack_round_bits,
     sanitize,
 )
 
@@ -164,7 +165,11 @@ def decide_fame_impl(cfg: DagConfig, state: DagState,
     lcr = jnp.maximum(state.lcr, new_lcr)
 
     famous_out = state.famous.at[:R].set(famous)
-    return state._replace(famous=famous_out, lcr=lcr)
+    # fame rewrote the famous table: refresh the packed bitplanes so
+    # the order phase's popcount reception tallies read fresh lanes
+    return repack_round_bits(
+        cfg, state._replace(famous=famous_out, lcr=lcr)
+    )
 
 
 def _lcr_candidates(state, i_idx, in_window, decided_round, has_w,
@@ -281,9 +286,9 @@ def decide_fame_block_impl(
         )
     hi = jnp.clip(hi_abs - state.r_off, 0, R)
     famous_out = jax.lax.fori_loop(lo, hi, round_body, state.famous)
-    return state._replace(
+    return repack_round_bits(cfg, state._replace(
         famous=famous_out, lcr=fame_advance_lcr(cfg, state, famous_out, gate)
-    )
+    ))
 
 
 def fame_round_init(

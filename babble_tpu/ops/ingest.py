@@ -37,10 +37,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .pack import count_bits
 from .ss import ss_counts
 from .state import (
-    INT32_MAX, DagConfig, DagState, I32, I64, retired_mask, sanitize,
-    set_sentinel,
+    INT32_MAX, DagConfig, DagState, I32, I64, repack_round_bits,
+    retired_mask, sanitize, set_sentinel,
 )
 
 
@@ -359,7 +360,11 @@ def _rounds_level_scan(
         wsl = wslot[jnp.clip(pr_loc, 0, cfg.r_cap)]               # [B, N]
         fdw = state.fd[sanitize(wsl, cfg.e_cap)]                  # [B, N, N]
         la_x = state.la[idx]                                      # [B, N]
-        ss_cnt = (la_x[:, None, :] >= fdw).sum(-1)                # [B, N]
+        ss_see = la_x[:, None, :] >= fdw                          # [B, N, N]
+        # packed diet: the per-participant see bits tally by popcount
+        # over uint8 lanes instead of a widening bool sum — identical
+        # integers, 8:1 smaller reduction input (ops/pack.py)
+        ss_cnt = count_bits(ss_see) if cfg.packed else ss_see.sum(-1)
         sm_x = state.sm[jnp.clip(pr_loc, 0, cfg.r_cap)]           # [B]
         ss = (ss_cnt >= sm_x[:, None]) & (wsl >= 0)
         inc = ss.sum(-1) >= sm_x
@@ -695,7 +700,9 @@ def ingest_rounds_impl(
             state.n_events - batch.k, cfg, batch.sched
         )
         state = _rounds_level_scan(state, cfg, slot_sched, batch.sched)
-    return _reset_round_sentinels(state, cfg)
+    # the rounds phase rewrote the witness tables: refresh the packed
+    # per-round bitplanes (derived caches — see state.repack_round_bits)
+    return repack_round_bits(cfg, _reset_round_sentinels(state, cfg))
 
 
 def ingest_impl(cfg: DagConfig, state: DagState, fd_mode: str, batch: EventBatch) -> DagState:
@@ -750,11 +757,12 @@ def rescan_rounds_impl(
     # dump row into live round rows as phantom witnesses.
     e_row = jnp.arange(e1) == cfg.e_cap
     r_row = (jnp.arange(cfg.r_cap + 1) == cfg.r_cap)[:, None]
-    return state._replace(
+    state = state._replace(
         round=set_sentinel(state.round, e_row, -1),
         witness=set_sentinel(state.witness, e_row, False),
         wslot=set_sentinel(state.wslot, r_row, -1),
     )
+    return repack_round_bits(cfg, state)
 
 
 rescan_rounds = jax.jit(rescan_rounds_impl, static_argnums=(0,), donate_argnums=(1,))

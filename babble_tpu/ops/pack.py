@@ -1,0 +1,60 @@
+"""Bit-packing primitives for the kernel working-set diet (ROADMAP
+item 4): boolean see/strongly-see/vote tensors stored 8:1 as uint8
+lanes along the participant axis, with supermajority tallies counted by
+``jax.lax.population_count`` instead of f32 einsum reductions.
+
+Layout contract (shared with the numpy twin in ops/state.py
+``repack_round_bits_np`` and with checkpoint backfill): lanes are
+LITTLE-endian — bit ``j`` of lane ``l`` is participant ``8*l + j`` —
+matching ``np.packbits(..., bitorder="little")``.  Popcount tallies are
+bit-order-agnostic, but bitwise combinations (the packed coin-vote
+select in ops/flush.py) require every packed operand to share one
+layout, so the contract is explicit.
+
+Padding lanes (participants past ``n``) pack to zero bits, which makes
+them neutral under ``&``/popcount — the same sentinel discipline the
+wide tensors use (la=-1 / fd=INF contribute to no count).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LANE = 8
+U8 = jnp.uint8
+I32 = jnp.int32
+
+_WEIGHTS = tuple(1 << j for j in range(LANE))
+
+
+def lane_count(n: int) -> int:
+    """uint8 lanes covering ``n`` participant bits: ``ceil(n/8)``."""
+    return -(-n // LANE)
+
+
+def pack_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """bool[..., n] -> uint8[..., ceil(n/8)], little-endian lanes."""
+    n = x.shape[-1]
+    pad = lane_count(n) * LANE - n
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros(x.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    r = x.reshape(x.shape[:-1] + (lane_count(n), LANE))
+    w = jnp.asarray(_WEIGHTS, I32)
+    # accumulate in i32 (exact: lane totals < 256), narrow once
+    return (r.astype(I32) * w).sum(-1).astype(U8)
+
+
+def popcount_sum(x: jnp.ndarray) -> jnp.ndarray:
+    """uint[..., L] -> i32[...]: total set bits over the lane axis."""
+    return jax.lax.population_count(x).astype(I32).sum(-1)
+
+
+def count_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """bool[..., n] -> i32[...]: the packed twin of ``x.sum(-1)`` —
+    pack to uint8 lanes, popcount, reduce.  Exact for any n (popcounts
+    are integer), used for every supermajority tally on the packed
+    kernel path."""
+    return popcount_sum(pack_bits(x))

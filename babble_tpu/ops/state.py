@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..membership.quorum import supermajority
+from .pack import lane_count, pack_bits
 
 I32 = jnp.int32
 I64 = jnp.int64
@@ -89,6 +90,13 @@ class DagConfig(NamedTuple):
     coord8: bool = False     # overrides coord16 (shallowest chains only)
     ts32: bool = False       # i32 relative timestamps in the order median
     retired: Tuple[int, ...] = ()   # columns of departed members
+    # kernel working-set diet (ROADMAP item 4): run the windowed fame
+    # vote recursion and the order reception tallies over 8:1 bit-packed
+    # uint8 lanes with popcount supermajorities instead of f32 einsum
+    # tallies (ops/pack.py).  Counts are exact integers either way, so
+    # the flag is bit-parity-preserving — it selects kernel math, not
+    # semantics (differentially pinned in tests/test_diet.py).
+    packed: bool = False
 
     @property
     def n_cols(self) -> int:
@@ -103,6 +111,12 @@ class DagConfig(NamedTuple):
     @property
     def super_majority(self) -> int:
         return supermajority(self.active_n)
+
+    @property
+    def lp(self) -> int:
+        """uint8 lanes of the packed participant axis: ``ceil(n/8)``.
+        Re-buckets when an epoch join widens the participant axis."""
+        return lane_count(self.n)
 
     @property
     def coord_dtype(self):
@@ -202,6 +216,16 @@ class DagState(NamedTuple):
     # regardless of which side of the apply it arrived on.  Row r_cap
     # is the backfill default compact() rolls in for fresh rounds.
     sm: jnp.ndarray        # i32[R+1]
+    # packed per-round witness bitplanes (kernel working-set diet,
+    # ROADMAP item 4): uint8 lanes along the participant axis, bit j of
+    # lane l = creator 8l+j (ops/pack.py little-endian contract).  Both
+    # are pure DERIVED caches of the wide tensors — recomputed by
+    # repack_round_bits wherever wslot/famous/mbit change wholesale and
+    # re-packed from the wide tensors at checkpoint restore — persisted
+    # so the packed kernels read W-row lane slices instead of
+    # re-gathering [W, N] event fields every flush.
+    mbr: jnp.ndarray       # u8[R+1, LP] coin bits of each round's witnesses
+    fmr: jnp.ndarray       # u8[R+1, LP] famous==TRUE bitmap per round
 
     # scalars
     n_events: jnp.ndarray  # i32  live (windowed) event count
@@ -226,7 +250,7 @@ class DagState(NamedTuple):
 AXIS_CLASSIFIED_STATE = "DagState"
 PER_EVENT_FIELDS = ("sp", "op", "creator", "seq", "ts", "mbit",
                     "la", "fd", "round", "witness", "rr", "cts")
-PER_ROUND_FIELDS = ("wslot", "famous", "sm")
+PER_ROUND_FIELDS = ("wslot", "famous", "sm", "mbr", "fmr")
 PER_CREATOR_FIELDS = ("ce", "cnt", "s_off")
 SCALAR_FIELDS = ("n_events", "max_round", "lcr", "e_off", "r_off")
 
@@ -267,6 +291,10 @@ def init_state(cfg: DagConfig,
         wslot=jnp.full((r1, n), -1, I32),
         famous=jnp.zeros((r1, n), jnp.int8),
         sm=jnp.full((r1,), cfg.super_majority, I32),
+        # packed bitplanes of an empty witness table are all-zero —
+        # exactly what repack_round_bits computes over sentinel rows
+        mbr=jnp.zeros((r1, cfg.lp), jnp.uint8),
+        fmr=jnp.zeros((r1, cfg.lp), jnp.uint8),
         n_events=jnp.zeros((), I32),
         max_round=jnp.full((), -1, I32),
         lcr=jnp.full((), -1, I32),
@@ -307,6 +335,8 @@ def grow_state(state: DagState, old: DagConfig, new: DagConfig) -> DagState:
         wslot=fresh.wslot.at[: old.r_cap].set(state.wslot[: old.r_cap]),
         famous=fresh.famous.at[: old.r_cap].set(state.famous[: old.r_cap]),
         sm=fresh.sm.at[: old.r_cap].set(state.sm[: old.r_cap]),
+        mbr=fresh.mbr.at[: old.r_cap].set(state.mbr[: old.r_cap]),
+        fmr=fresh.fmr.at[: old.r_cap].set(state.fmr[: old.r_cap]),
         n_events=state.n_events,
         max_round=state.max_round,
         lcr=state.lcr,
@@ -367,6 +397,12 @@ def compact_impl(
         # fresh rounds inherit the CURRENT epoch's threshold from the
         # sentinel row; rolled-off old-epoch rows are decided history
         sm=state.sm[ridx],
+        # packed bitplanes roll with their rounds: surviving rows keep
+        # witnesses whose slots survive (rounds below new r_off are the
+        # only ones holding evicted slots), and the all-zero sentinel
+        # row backfills fresh rounds like every other per-round table
+        mbr=state.mbr[ridx],
+        fmr=state.fmr[ridx],
         n_events=state.n_events - de,
         e_off=state.e_off + de,
         s_off=new_s_off,
@@ -463,6 +499,38 @@ def set_sentinel(a: jnp.ndarray, mask: jnp.ndarray, v) -> jnp.ndarray:
     selects partition trivially.  Build ``mask`` as
     ``jnp.arange(dim) == sentinel`` (broadcast to the array's rank)."""
     return jnp.where(mask, jnp.asarray(v, a.dtype), a)
+
+
+def repack_round_bits(cfg: DagConfig, state: DagState) -> DagState:
+    """Recompute the packed per-round witness bitplanes (``mbr``,
+    ``fmr``) from the wide tensors — they are pure derived caches, so
+    wholesale recomputation is the one maintenance discipline that can
+    never drift.  O(R·N) gather+pack: negligible next to any phase
+    that changed the inputs.  Called at the end of every program that
+    rewrites wslot/mbit (ingest rounds, rescan) or famous (fame)."""
+    valid = state.wslot >= 0
+    ws = sanitize(state.wslot, cfg.e_cap)
+    mb = state.mbit[ws] & valid
+    fm = (state.famous == FAME_TRUE) & valid
+    return state._replace(mbr=pack_bits(mb), fmr=pack_bits(fm))
+
+
+def repack_round_bits_np(cfg: DagConfig, wslot: np.ndarray,
+                         famous: np.ndarray, mbit: np.ndarray):
+    """Numpy twin of ``repack_round_bits`` for host-side rebuilds —
+    epoch re-shapes (the lane count re-buckets when a join widens the
+    participant axis) and checkpoint restore (pre-v5 checkpoints carry
+    no bitplanes; v5+ ones are re-packed rather than trusted, which
+    also closes the hostile inconsistent-snapshot hole).  Bit order
+    matches ops/pack.py: ``np.packbits(..., bitorder="little")``."""
+    valid = wslot >= 0
+    ws = np.where(valid, wslot, cfg.e_cap)
+    mb = mbit[np.clip(ws, 0, cfg.e_cap)] & valid
+    fm = (famous == FAME_TRUE) & valid
+    lp = cfg.lp
+    mbr = np.packbits(mb, axis=-1, bitorder="little")[..., :lp]
+    fmr = np.packbits(fm, axis=-1, bitorder="little")[..., :lp]
+    return mbr.astype(np.uint8), fmr.astype(np.uint8)
 
 
 # Consensus-observable tensors: every decision the pipeline emits.  The

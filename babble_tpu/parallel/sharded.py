@@ -48,6 +48,13 @@ def state_specs() -> DagState:
         ce=P(), cnt=P(),
         wslot=P(None, "p"), famous=P(None, "p"),
         sm=P(),
+        # packed witness bitplanes (kernel diet): REPLICATED.  The
+        # uint8 lane axis is ceil(n/8) — 8 participant columns per
+        # lane — so "p" rarely divides it (it divides n, not n/8), and
+        # at [R+1, ceil(N/8)] bytes the planes are ~1/32768th of one
+        # fd tensor at 10k participants: replication costs nothing and
+        # keeps the lane math local to every shard
+        mbr=P(), fmr=P(),
         n_events=P(), max_round=P(), lcr=P(),
         e_off=P(), s_off=P(), r_off=P(),
     )
@@ -87,6 +94,7 @@ def pad_cfg_for_mesh(cfg: DagConfig, mesh: Mesh) -> DagConfig:
     return DagConfig(
         n=n_pad, e_cap=e_cap, s_cap=cfg.s_cap, r_cap=cfg.r_cap,
         n_real=n_real, coord16=cfg.coord16, coord8=cfg.coord8,
+        packed=cfg.packed,
     )
 
 

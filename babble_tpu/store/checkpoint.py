@@ -36,7 +36,14 @@ from ..ops.state import DagConfig, DagState, config_from_fields
 #: per-round ``sm`` threshold array, and the meta carries
 #: epoch/membership_log/pending_membership.  v2/v3 checkpoints restore
 #: with epoch-0 defaults (sm backfilled uniform).
-FORMAT_VERSION = 4
+#: v5 (kernel working-set diet): cfg grew the ``packed`` flag and
+#: DagState the packed per-round witness bitplanes ``mbr``/``fmr``.
+#: The planes are pure derived caches, so EVERY restore re-packs them
+#: from the wide tensors (wslot/famous/mbit) instead of trusting the
+#: serialized bytes — pre-v5 checkpoints backfill for free, and a
+#: hostile snapshot cannot smuggle bitplanes inconsistent with the
+#: tables they cache.
+FORMAT_VERSION = 5
 
 _META = "meta.msgpack"
 _DEVICE = "device.npz"
@@ -636,6 +643,8 @@ def _expected_layout(cfg: DagConfig) -> Dict[str, tuple]:
         "ce": ((n + 1, s1), i32), "cnt": ((n + 1,), i32),
         "wslot": ((r1, n), i32), "famous": ((r1, n), i8),
         "sm": ((r1,), i32),
+        "mbr": ((r1, cfg.lp), np.dtype(np.uint8)),
+        "fmr": ((r1, cfg.lp), np.dtype(np.uint8)),
         "n_events": (sc, i32), "max_round": (sc, i32), "lcr": (sc, i32),
         "e_off": (sc, i32), "s_off": ((n + 1,), i32), "r_off": (sc, i32),
     }
@@ -752,6 +761,10 @@ def load_snapshot(
                 # epoch-0 thresholds are uniform, so backfill is exact
                 if name == "sm" and meta["version"] < 4:
                     continue
+                # pre-v5 snapshots carry no packed bitplanes; they are
+                # derived caches, re-packed from the wide tensors
+                if name in ("mbr", "fmr") and meta["version"] < 5:
+                    continue
                 raise ValueError(f"snapshot missing array {name}")
             shape, dtype = layout[name]
             eshape, edtype = expected[name]
@@ -762,6 +775,7 @@ def load_snapshot(
                 )
         arrays = {name: z[name] for name in expected if name in layout}
     _backfill_sm(arrays, cfg)
+    _backfill_packed(arrays, cfg)
     if wide:
         engine = _restore_wide_engine(meta, arrays, commit_callback, policy)
     else:
@@ -782,6 +796,23 @@ def _backfill_sm(arrays: Dict[str, np.ndarray], cfg: DagConfig) -> None:
     if "sm" not in arrays:
         arrays["sm"] = np.full((cfg.r_cap + 1,), cfg.super_majority,
                                np.int32)
+
+
+def _backfill_packed(arrays: Dict[str, np.ndarray],
+                     cfg: DagConfig) -> None:
+    """Re-pack the per-round witness bitplanes from the wide tensors on
+    EVERY restore (v5): they are derived caches, so recomputation both
+    backfills pre-v5 checkpoints and refuses to trust serialized planes
+    a hostile snapshot could have made inconsistent with the tables
+    they cache.  Wide-engine checkpoints restore through here too —
+    their kernels never maintain the planes, so the saved bytes may be
+    stale; the re-pack makes that unobservable."""
+    from ..ops.state import repack_round_bits_np
+
+    arrays["mbr"], arrays["fmr"] = repack_round_bits_np(
+        cfg, np.asarray(arrays["wslot"]), np.asarray(arrays["famous"]),
+        np.asarray(arrays["mbit"]),
+    )
 
 
 def load_checkpoint_tolerant(
@@ -819,11 +850,13 @@ def load_checkpoint(
         with np.load(os.path.join(path, _DEVICE)) as z:
             arrays = {name: z[name] for name in names if name in z.files}
         _backfill_sm(arrays, cfg)
+        _backfill_packed(arrays, cfg)
         return _restore_wide_engine(meta, arrays, commit_callback)
     with np.load(os.path.join(path, _DEVICE)) as z:
         arrays = {name: z[name]
                   for name in DagState._fields if name in z.files}
     _backfill_sm(arrays, config_from_fields(meta["cfg"]))
+    _backfill_packed(arrays, config_from_fields(meta["cfg"]))
     return _restore_engine(meta, arrays, commit_callback)
 
 
@@ -835,7 +868,7 @@ def _restore_engine(
 ) -> TpuHashgraph:
     # v2 lacks the coord16 cfg field, v3 the membership-plane fields
     # (retired cfg column, sm array, epoch ledger) — all default-filled
-    if meta["version"] not in (2, 3, FORMAT_VERSION):
+    if meta["version"] not in (2, 3, 4, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     from ..ops.state import coord8_ok, coord16_ok
     cfg_chk = config_from_fields(meta["cfg"])
@@ -955,7 +988,7 @@ def _restore_wide_engine(
     from ..consensus.wide_engine import WideHashgraph
     from ..ops.wide import MarchCarry
 
-    if meta["version"] not in (2, 3, FORMAT_VERSION):
+    if meta["version"] not in (2, 3, 4, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     policy = policy or {}
     participants: Dict[str, int] = {

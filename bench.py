@@ -1199,6 +1199,110 @@ def run_stream(n: int = 4, live_measure_s: float = 20.0,
     return out
 
 
+def run_diet(n: int = 4, events: int = 360, chunk: int = 8) -> dict:
+    """Kernel working-set diet (ISSUE 14 / ROADMAP item 4):
+    BENCH_DIET.json — the before/after meter for the event-axis
+    frontier + bit-packed popcount votes, on the SAME canned
+    flush-stream shape run_stream's child drives (4 x 360, seed 17,
+    8-event gossip chunks, gated latency kernel).
+
+    Two arms, one DAG: **wide** pins the pre-diet kernels
+    (packed_votes=False, frontier=False — full-height fd scans, f32
+    einsum tallies) and **diet** runs the defaults.  Each arm runs
+    phase-probed, so the artifact carries:
+
+    - ``babble_flush_bytes_estimate_total{phase}`` sums per arm + the
+      per-phase deltas (the acceptance gate is order >= 2x down);
+    - the ``--phase_probe`` ingest/fame/order wall sums;
+    - the parity verdict: committed order AND the consensus-observable
+      event tensors (ops/state.CONSENSUS_EVENT_FIELDS) bit-identical
+      across the arms — the diet is a working-set change, never a
+      semantics change."""
+    import numpy as np
+
+    from babble_tpu.consensus.engine import TpuHashgraph
+    from babble_tpu.ops.state import CONSENSUS_EVENT_FIELDS
+    from babble_tpu.sim import random_gossip_dag
+
+    dag = random_gossip_dag(n, events, seed=17)
+
+    def one_pass(**kw):
+        eng = TpuHashgraph(dag.participants, verify_signatures=False,
+                           kernel_class="latency", finality_gate=True,
+                           **kw)
+        eng.phase_probe = True   # the per-phase wall meter (ISSUE 11 c)
+        bytes_total = {"ingest": 0, "fame": 0, "order": 0, "total": 0}
+        walls = {"ingest_s": 0.0, "fame_s": 0.0, "order_s": 0.0}
+        order, flushes = [], 0
+        t0 = time.perf_counter()
+        for i, ev in enumerate(dag.events):
+            eng.insert_event(ev.clone())
+            if (i + 1) % chunk == 0:
+                order += [e.hex() for e in eng.run_consensus()]
+                flushes += 1
+                fb = eng.last_flush_bytes or {}
+                for k in bytes_total:
+                    bytes_total[k] += fb.get(k, 0)
+                for k in walls:
+                    walls[k] += (eng._last_phase_timings or {}).get(k, 0.0)
+        order += [e.hex() for e in eng.run_consensus()]
+        wall_s = time.perf_counter() - t0
+        return {
+            "flushes": flushes,
+            "frontier_bucket": getattr(eng, "_last_frontier_f", None),
+            "babble_flush_bytes_estimate_total": bytes_total,
+            "phase_walls_s": {k: round(v, 4) for k, v in walls.items()},
+            "stream_wall_s": round(wall_s, 3),
+            "ordered": len(order),
+        }, order, eng
+
+    def arm(**kw):
+        # pass 1 warms the jit cache (every shape bucket the stream
+        # hits compiles here); pass 2 re-runs the identical stream on a
+        # fresh engine so the phase walls measure steady-state kernels,
+        # not compile storms — the compile-count regression tests prove
+        # the second pass traces nothing
+        one_pass(**kw)
+        return one_pass(**kw)
+
+    wide, o_wide, e_wide = arm(packed_votes=False, frontier=False)
+    diet, o_diet, e_diet = arm()
+
+    parity = o_wide == o_diet
+    field_parity = {}
+    for f in CONSENSUS_EVENT_FIELDS:
+        a = np.asarray(getattr(e_wide.state, f))
+        b = np.asarray(getattr(e_diet.state, f))
+        field_parity[f] = bool((a == b).all())
+    parity = parity and all(field_parity.values())
+
+    bw = wide["babble_flush_bytes_estimate_total"]
+    bd = diet["babble_flush_bytes_estimate_total"]
+    drops = {ph: round(bw[ph] / bd[ph], 2) if bd[ph] else None
+             for ph in ("ingest", "fame", "order", "total")}
+    ww, wd = wide["phase_walls_s"], diet["phase_walls_s"]
+    out = {
+        "shape": {"n": n, "events": events, "chunk": chunk, "seed": 17},
+        "host_cores": os.cpu_count(),
+        "wide": wide,
+        "diet": diet,
+        "bytes_drop_x": drops,
+        "order_bytes_drop_at_least_2x": (
+            drops["order"] is not None and drops["order"] >= 2.0
+        ),
+        "phase_walls_down": {
+            k: ww[k] > wd[k] for k in ("fame_s", "order_s")
+        },
+        "parity": "ok" if parity else "MISMATCH",
+        "parity_fields": field_parity,
+    }
+    log(f"[diet] order bytes {bw['order']:,} -> {bd['order']:,} "
+        f"({drops['order']}x), fame wall {ww['fame_s']:.3f} -> "
+        f"{wd['fame_s']:.3f}s, order wall {ww['order_s']:.3f} -> "
+        f"{wd['order_s']:.3f}s, parity {out['parity']}")
+    return out
+
+
 def _gated(tag: str, est_s: float, fn):
     """Run an optional config iff the remaining budget covers its
     estimated cost; record the outcome in the summary either way."""
@@ -1568,6 +1672,16 @@ def main() -> None:
         _SUMMARY["stream_live_eps"] = stream.get(
             "live_events_per_sec_gossip")
 
+    # kernel working-set diet (ISSUE 14): frontier + packed-vote
+    # before/after on the canned flush-stream shape, parity-gated
+    stage("diet")
+    diet = _gated("diet", 180, run_diet)
+    if diet is not None:
+        with open("BENCH_DIET.json", "w") as f:
+            json.dump(diet, f, indent=1)
+        _SUMMARY["diet_order_bytes_drop_x"] = diet["bytes_drop_x"]["order"]
+        _SUMMARY["diet_parity"] = diet["parity"]
+
     # attribution plane (ISSUE 11): tracing-overhead A/B + the sample
     # stitched trace artifact
     stage("obs_overhead")
@@ -1697,6 +1811,19 @@ if __name__ == "__main__":
             "overhead_under_5pct": res.get("overhead_under_5pct"),
             "trace_stages": res["on"].get("trace_stages"),
             "trace_nodes": res["on"].get("trace_nodes"),
+        }))
+    elif len(sys.argv) > 1 and sys.argv[1] == "diet":
+        # standalone kernel working-set-diet bench (BENCH_DIET.json)
+        res = run_diet()
+        with open("BENCH_DIET.json", "w") as f:
+            json.dump(res, f, indent=1)
+        print(json.dumps({
+            "order_bytes_drop_x": res["bytes_drop_x"]["order"],
+            "total_bytes_drop_x": res["bytes_drop_x"]["total"],
+            "order_bytes_drop_at_least_2x":
+                res["order_bytes_drop_at_least_2x"],
+            "phase_walls_down": res["phase_walls_down"],
+            "parity": res["parity"],
         }))
     elif len(sys.argv) > 1 and sys.argv[1] == "stream":
         # standalone streaming-engine bench (writes BENCH_STREAM.json)

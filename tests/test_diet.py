@@ -1,0 +1,331 @@
+"""Kernel working-set diet (ISSUE 14): the acceptance pins.
+
+- **Packed-vote parity**: popcount supermajority tallies over 8:1
+  uint8 lanes commit the SAME order as the f32 einsum tallies — across
+  seeds, and with coin rounds forced (small active_n makes
+  ``d % N == 0`` voting distances unavoidable).
+- **Frontier parity**: the F-row event-axis frontier slice in the
+  windowed order phase is bit-identical to full-height fd scans —
+  including after compaction/eviction rolled the windows, and across
+  an epoch re-shape (the packed lane count re-buckets on a join).
+- **Compile-count regression**: a same-bucket flush stream with a
+  GROWING frontier triggers zero new XLA compiles/traces (the slice
+  offset is traced; only the bucket is static).
+- **Checkpoint FORMAT v5**: packed bitplanes round-trip; pre-v5
+  checkpoints backfill by re-packing from the wide tensors.
+- **Chaos fingerprint parity**: the canned fault shapes commit
+  bit-identical fingerprints with the diet on and off.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from babble_tpu.consensus.engine import TpuHashgraph
+from babble_tpu.ops import aot
+from babble_tpu.ops.state import (
+    CONSENSUS_EVENT_FIELDS,
+    DagConfig,
+    repack_round_bits_np,
+)
+from babble_tpu.sim import random_gossip_dag
+
+
+def _stream(dag, chunk, **kw):
+    # e_cap=512 keeps the FULL-HEIGHT (frontier-off / F=e1) arm's
+    # compile cost down — the parity claims are capacity-independent
+    kw.setdefault("e_cap", 512)
+    eng = TpuHashgraph(dag.participants, verify_signatures=False, **kw)
+    out = []
+    for i, ev in enumerate(dag.events):
+        eng.insert_event(ev.clone())
+        if (i + 1) % chunk == 0:
+            out += [e.hex() for e in eng.run_consensus()]
+    out += [e.hex() for e in eng.run_consensus()]
+    return eng, out
+
+
+def _assert_state_parity(a, b):
+    for f in CONSENSUS_EVENT_FIELDS:
+        x, y = np.asarray(getattr(a.state, f)), np.asarray(getattr(b.state, f))
+        assert (x == y).all(), f"{f} diverged between diet arms"
+    assert (np.asarray(a.state.famous) == np.asarray(b.state.famous)).all()
+
+
+# ----------------------------------------------------------------------
+# packed votes: popcount tallies == f32 einsum tallies, bit for bit
+
+
+@pytest.mark.parametrize("seed,n", [(0, 4), (1, 4), (2, 4), (3, 4),
+                                    (0, 2), (1, 2)])
+def test_packed_vote_fame_parity(seed, n):
+    """The popcount tally path and the f32 einsum path decide identical
+    fame, order and timestamps.  n=2 forces a COIN round at every even
+    voting distance (d % active_n == 0, the hashgraph.go:643 period),
+    so the packed bitwise coin select — (strong & v) | (~strong & mbr)
+    — is exercised, not just the normal-round tally."""
+    dag = random_gossip_dag(n, 110, seed=seed)
+    e_pk, o_pk = _stream(dag, 8, kernel_class="latency",
+                         finality_gate=True, packed_votes=True)
+    e_f32, o_f32 = _stream(dag, 8, kernel_class="latency",
+                           finality_gate=True, packed_votes=False)
+    assert e_pk.cfg.packed and not e_f32.cfg.packed
+    assert len(o_pk) > 0, "nothing committed — vacuous parity"
+    assert o_pk == o_f32
+    assert e_pk.consensus_events() == e_f32.consensus_events()
+    _assert_state_parity(e_pk, e_f32)
+    # the packed bitplanes are maintained identically on both paths
+    # (they are derived caches of the same wide tensors)
+    assert (np.asarray(e_pk.state.mbr) == np.asarray(e_f32.state.mbr)).all()
+    assert (np.asarray(e_pk.state.fmr) == np.asarray(e_f32.state.fmr)).all()
+
+
+def test_pack_helpers_match_numpy():
+    """ops/pack.py lanes are np.packbits(bitorder='little') — the
+    layout contract repack_round_bits_np and checkpoint backfill share
+    — including a participant count that is not a lane multiple."""
+    import jax.numpy as jnp
+
+    from babble_tpu.ops.pack import count_bits, lane_count, pack_bits
+
+    rng = np.random.default_rng(7)
+    for n in (3, 8, 11, 16):
+        x = rng.random((5, n)) < 0.5
+        got = np.asarray(pack_bits(jnp.asarray(x)))
+        want = np.packbits(x, axis=-1, bitorder="little")
+        assert got.shape == (5, lane_count(n))
+        assert (got == want).all()
+        assert (np.asarray(count_bits(jnp.asarray(x)))
+                == x.sum(-1)).all()
+
+
+# ----------------------------------------------------------------------
+# event-axis frontier: sliced reception scans == full height
+
+
+def test_frontier_vs_full_height_after_compaction():
+    """Frontier slicing is exact across rolled windows: a compacting
+    engine (eviction moves the slot base and the reception frontier
+    with it) commits the identical order with the frontier on and off,
+    and actually used a bucket below full height."""
+    dag = random_gossip_dag(4, 320, seed=21)
+    kw = dict(kernel_class="latency", finality_gate=True,
+              auto_compact=True, seq_window=24, compact_min=16)
+    e_fr, o_fr = _stream(dag, 8, frontier=True, **kw)
+    e_full, o_full = _stream(dag, 8, frontier=False, **kw)
+    assert e_fr.dag.slot_base > 0, "compaction never ran — weak test"
+    assert o_fr == o_full
+    assert e_fr.consensus_events() == e_full.consensus_events()
+    _assert_state_parity(e_fr, e_full)
+    f = getattr(e_fr, "_last_frontier_f", None)
+    assert f is not None and f < e_fr.cfg.e_cap + 1, \
+        "frontier never picked a sub-full bucket — weak test"
+
+
+def test_frontier_bucket_rebuckets_across_epoch_reshape():
+    """A join widens the participant axis mid-window: the packed lane
+    count must re-bucket (ceil(8/8)=1 -> ceil(9/8)=2 lanes) and the
+    re-shaped bitplanes must equal a fresh re-pack of the widened wide
+    tensors (ops/epoch.py recomputes them host-side)."""
+    from babble_tpu.ops.epoch import epoch_transition_arrays
+
+    dag = random_gossip_dag(8, 160, seed=5)
+    eng, _ = _stream(dag, 16, kernel_class="latency", finality_gate=True)
+    assert eng.cfg.lp == 1
+    lcr = int(eng.state.lcr)
+    assert lcr >= 0, "no decided round — weak test"
+    new_cfg = eng.cfg._replace(n=eng.cfg.n + 1)
+    a = epoch_transition_arrays(eng.cfg, new_cfg, eng.state, lcr)
+    r1 = eng.cfg.r_cap + 1
+    assert new_cfg.lp == 2
+    assert a["mbr"].shape == (r1, 2)
+    assert a["fmr"].shape == (r1, 2)
+    mbr, fmr = repack_round_bits_np(
+        new_cfg, a["wslot"], a["famous"], a["mbit"]
+    )
+    assert (a["mbr"] == mbr).all()
+    assert (a["fmr"] == fmr).all()
+
+
+def test_frontier_aware_bytes_model():
+    """The fd/rr/cts/median order rows scale with the live frontier
+    height, not e1, and packed votes shrink the fame temporaries."""
+    from babble_tpu.ops.flush import flush_bytes_estimate
+
+    cfg = DagConfig(n=8, e_cap=4096, s_cap=256, r_cap=64)
+    full = flush_bytes_estimate(cfg, W=4, k=16)          # F defaults to e1
+    diet = flush_bytes_estimate(cfg, W=4, k=16, F=64)
+    assert diet["order"] * 2 <= full["order"]
+    assert diet["ingest"] == full["ingest"]
+    packed = flush_bytes_estimate(cfg._replace(packed=True), W=4, k=16, F=64)
+    assert packed["fame"] < diet["fame"]
+
+
+def test_frontier_parity_across_capacity_growth():
+    """A latency flush whose build_batch grows e_cap must size the
+    frontier bucket against the POST-growth capacity (review finding:
+    sized before growth, bucket_f clamps to the old e1 and a flush
+    with live rows past it could under-cover the undecided span —
+    skipped receptions are permanent).  Tiny-capacity engines force
+    growth mid-stream; parity with the frontier-off pin is the net."""
+    dag = random_gossip_dag(4, 200, seed=13)
+    kw = dict(e_cap=128, kernel_class="latency", finality_gate=True)
+    e_fr, o_fr = _stream(dag, 8, frontier=True, **kw)
+    e_full, o_full = _stream(dag, 8, frontier=False, **kw)
+    assert e_fr.cfg.e_cap > 128, "capacity never grew — weak test"
+    assert o_fr == o_full
+    _assert_state_parity(e_fr, e_full)
+
+
+# ----------------------------------------------------------------------
+# compile-count regression: growing frontier, same bucket, zero compiles
+
+
+def test_growing_frontier_same_bucket_zero_recompiles():
+    """The frontier slice OFFSET is traced (it moves every flush); only
+    the bucket F is static.  A warm identical stream — during which the
+    host frontier mirror demonstrably advances — must trigger ZERO new
+    XLA compiles and ZERO retraces, or the diet would have re-armed the
+    compile storm the AOT manifest exists to kill."""
+    aot.install_listeners()
+    dag = random_gossip_dag(4, 240, seed=23)
+
+    def stream_once():
+        eng = TpuHashgraph(dag.participants, verify_signatures=False,
+                           kernel_class="latency", finality_gate=True)
+        frontiers = []
+        for i, ev in enumerate(dag.events):
+            eng.insert_event(ev.clone())
+            if (i + 1) % 4 == 0:
+                eng.run_consensus()
+                frontiers.append(eng._frontier_cache)
+        return frontiers
+
+    stream_once()                       # compiles every bucket shape
+    c0 = aot.compile_counts()
+    frontiers = stream_once()
+    c1 = aot.compile_counts()
+    moved = any(b > a for a, b in zip(frontiers, frontiers[1:]))
+    assert moved, "frontier never advanced — weak test"
+    assert c1["xla_compiles"] == c0["xla_compiles"], (c0, c1)
+    assert c1["traces"] == c0["traces"], (c0, c1)
+
+
+# ----------------------------------------------------------------------
+# checkpoint FORMAT v5: round trip + pre-v5 backfill
+
+
+def test_checkpoint_v5_packed_roundtrip_and_v4_backfill(tmp_path):
+    """v5 checkpoints carry the bitplanes and restore them consistent
+    with the wide tensors (restore re-packs rather than trusts); a
+    pre-v5 checkpoint — no mbr/fmr arrays, 9-field cfg — still loads,
+    backfilled by re-packing.  The version bump is pinned."""
+    import msgpack
+
+    from babble_tpu.store.checkpoint import (
+        FORMAT_VERSION,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    assert FORMAT_VERSION == 5
+
+    dag = random_gossip_dag(4, 120, seed=3)
+    eng, _ = _stream(dag, 8, kernel_class="latency", finality_gate=True)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(eng, path)
+
+    with np.load(os.path.join(path, "device.npz")) as z:
+        assert "mbr" in z.files and "fmr" in z.files
+        saved = {k: z[k] for k in z.files}
+
+    restored = load_checkpoint(path)
+    want_mbr, want_fmr = repack_round_bits_np(
+        restored.cfg, saved["wslot"], saved["famous"], saved["mbit"]
+    )
+    assert (np.asarray(restored.state.mbr) == want_mbr).all()
+    assert (np.asarray(restored.state.fmr) == want_fmr).all()
+    assert restored.consensus_events() == eng.consensus_events()
+
+    # forge a v4-era checkpoint: drop the bitplanes, strip the cfg to
+    # its 9 membership-plane fields, stamp the old version
+    meta_p = os.path.join(path, "meta.msgpack")
+    with open(meta_p, "rb") as f:
+        meta = msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+    meta["version"] = 4
+    meta["cfg"] = meta["cfg"][:9]
+    with open(meta_p, "wb") as f:
+        f.write(msgpack.packb(meta, use_bin_type=True))
+    old_arrays = {k: v for k, v in saved.items()
+                  if k not in ("mbr", "fmr")}
+    np.savez_compressed(os.path.join(path, "device.npz"), **old_arrays)
+
+    old = load_checkpoint(path)
+    assert not old.cfg.packed   # 9-field cfg predates the flag
+    want_mbr, want_fmr = repack_round_bits_np(
+        old.cfg, old_arrays["wslot"], old_arrays["famous"],
+        old_arrays["mbit"],
+    )
+    assert (np.asarray(old.state.mbr) == want_mbr).all()
+    assert (np.asarray(old.state.fmr) == want_fmr).all()
+    assert old.consensus_events() == eng.consensus_events()
+
+
+# ----------------------------------------------------------------------
+# chaos fingerprint parity: the canned fault shapes, diet on vs off
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2])
+def test_chaos_fingerprint_parity_diet(seed):
+    """The flaky-link mini shape (drops, duplicates, reorders) commits
+    a bit-identical fingerprint with the diet kernels and the pre-diet
+    kernels — the working-set cut is invisible to consensus."""
+    from babble_tpu.chaos import Scenario, run_scenario
+
+    spec = {
+        "name": "mini-flaky-diet", "nodes": 3, "steps": 48, "seed": seed,
+        "txs": 6, "tx_every": 6, "settle_rounds": 4,
+        "invariants": ["prefix_agreement", "liveness", "all_committed"],
+        "plan": {"default": {"drop": 0.12, "delay": 0.2,
+                             "delay_ms": [1, 3],
+                             "duplicate": 0.1, "reorder": 0.1}},
+    }
+    sc = Scenario.from_dict(spec)
+    a = run_scenario(sc, kernel_class="latency", diet=True)
+    b = run_scenario(sc, kernel_class="latency", diet=False)
+    assert a.report.ok, a.report.format()
+    assert a.committed == b.committed
+    assert a.fingerprint() == b.fingerprint()
+
+
+@pytest.mark.slow
+def test_chaos_fingerprint_parity_diet_slow_peer():
+    """Same pin under asymmetric delay (the slow-peer shape that found
+    premature intra-round finality): the finality gate defers rounds
+    identically whether the tallies are popcounts or f32 einsums."""
+    from babble_tpu.chaos import Scenario, run_scenario
+
+    spec = {
+        "name": "mini-slow-diet", "nodes": 4, "steps": 64, "seed": 1,
+        "txs": 6, "tx_every": 8, "settle_rounds": 5,
+        "invariants": ["prefix_agreement", "liveness"],
+        "plan": {
+            "default": {"drop": 0.03},
+            "overrides": [
+                {"src": 2, "delay": 1.0, "delay_ms": [2, 6]},
+                {"dst": 2, "delay": 1.0, "delay_ms": [2, 6]},
+            ],
+        },
+    }
+    sc = Scenario.from_dict(spec)
+    a = run_scenario(sc, kernel_class="latency", diet=True)
+    b = run_scenario(sc, kernel_class="latency", diet=False)
+    assert a.fingerprint() == b.fingerprint()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))

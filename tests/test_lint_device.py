@@ -158,7 +158,7 @@ def test_donate_through_resolves_the_wide_pipeline():
     through = idx.donate_through
     assert through.get("babble_tpu.ops.wide:run_wide_coords") == (1, 3, 4)
     assert through.get("babble_tpu.ops.wide:run_wide_rounds") == (1,)
-    assert through.get("babble_tpu.ops.flush:probed_flush") == (3,)
+    assert through.get("babble_tpu.ops.flush:probed_flush") == (4,)
     # the _jits dict factory resolved with its donating programs
     jits = idx.dict_factories["babble_tpu.ops.wide:_jits"]
     assert jits["write_batch"].donate == (0,)

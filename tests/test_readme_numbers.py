@@ -91,3 +91,23 @@ def test_live_loaded_number_matches_artifact():
         f"README says {readme_eps} ev/s loaded, BENCH_LIVE.json says "
         f"{artifact}"
     )
+
+
+def test_diet_numbers_match_artifact():
+    """The kernel-diet paragraph quotes the order-phase bytes drop;
+    it must match BENCH_DIET.json (and the artifact must satisfy the
+    ISSUE-14 acceptance gate it claims: >= 2x, parity ok)."""
+    path = os.path.join(ROOT, "BENCH_DIET.json")
+    if not os.path.exists(path):
+        pytest.skip("no diet artifact")
+    with open(path) as f:
+        diet = json.load(f)
+    m = re.search(r"drops \*\*([\d.]+)x\*\*", _readme())
+    assert m, "README diet drop row missing"
+    readme_x = float(m.group(1))
+    artifact = float(diet["bytes_drop_x"]["order"])
+    assert abs(readme_x - artifact) / artifact < 0.10, (
+        f"README says {readme_x}x, BENCH_DIET.json says {artifact}x"
+    )
+    assert diet["order_bytes_drop_at_least_2x"] is True
+    assert diet["parity"] == "ok"
