@@ -32,7 +32,20 @@ FAULT_KINDS = (
     # membership churn + adversarial time (runner-applied; recorded so
     # the schedule fingerprint covers them)
     "join", "leave", "clock_skew",
+    # WAN link models (ROADMAP items 3+5): token-bucket serialization
+    # delay and Gilbert–Elliott burst loss; lying_ts is the
+    # adversarial-timestamp byzantine actor's per-mint lie
+    "bw_delay", "ge_drop", "lying_ts",
 )
+
+#: one bandwidth-model sleep never exceeds this (a hostile/absurd plan
+#: must not wedge the runner behind a multi-minute awaited sleep)
+BW_DELAY_MAX_S = 1.0
+
+#: lying_ts offsets are uniform in ±this many ns (an hour: far outside
+#: any honest clamp window, so the defense — not luck — is what keeps
+#: the medians in the honest envelope)
+LIE_MAX_NS = 3_600_000_000_000
 
 
 @dataclass(frozen=True)
@@ -43,6 +56,9 @@ class OutboundFaults:
     delay_s: float = 0.0
     duplicate: bool = False
     reorder_s: float = 0.0
+    #: the drop came from the Gilbert–Elliott loss chain (metrics
+    #: split burst loss from uniform loss)
+    ge: bool = False
 
 
 class FaultInjector:
@@ -51,14 +67,25 @@ class FaultInjector:
         plan: FaultPlan,
         seed: int,
         clock: Optional[Callable[[], float]] = None,
+        tick_seconds: float = 0.05,
     ):
         self.plan = plan
         self.seed = seed
         self._clock = clock
         self._tick = 0.0
+        #: wall seconds one plan tick represents — the token bucket's
+        #: replenish clock (Scenario.tick_seconds; the deterministic
+        #: runner advances ticks manually, so bucket state stays a pure
+        #: function of the per-link message sequence)
+        self.tick_seconds = float(tick_seconds)
         self._rngs: Dict[Tuple[int, int], random.Random] = {}
         self._node_rngs: Dict[object, random.Random] = {}
         self._link_seq: Dict[Tuple[int, int], int] = {}
+        #: per-link token-bucket fill (bytes, may run negative as
+        #: queueing deficit) + the tick it was last updated
+        self._bw_state: Dict[Tuple[int, int], Tuple[float, float]] = {}
+        #: per-link Gilbert–Elliott state (True = bad/bursty)
+        self._ge_bad: Dict[Tuple[int, int], bool] = {}
         #: decision log — only fired faults are recorded; ``seq`` is the
         #: per-link attempt ordinal, so sorting by (src, dst, seq) gives
         #: a canonical schedule independent of global interleaving
@@ -147,12 +174,28 @@ class FaultInjector:
     def outbound(self, src: int, dst: int) -> OutboundFaults:
         """Draw the fault decisions for one sync attempt src -> dst.
         Quiesced attempts draw nothing, so the faulted portion of the
-        per-link stream stays aligned with its attempt count."""
+        per-link stream stays aligned with its attempt count.  Links
+        without Gilbert–Elliott config draw nothing for it either —
+        adding the model to one link never shifts another link's (or a
+        pre-WAN plan's) stream."""
         if self.quiesce:
             return OutboundFaults()
         f = self.plan.link(src, dst)
         rng = self._rng(src, dst)
         self._link_seq[(src, dst)] = self._link_seq.get((src, dst), 0) + 1
+        if f.ge_enabled:
+            key = (src, dst)
+            bad = self._ge_bad.get(key, False)
+            if bad:
+                if rng.random() < f.ge_p_bg:
+                    bad = False
+            elif rng.random() < f.ge_p_gb:
+                bad = True
+            self._ge_bad[key] = bad
+            p_loss = f.ge_drop_bad if bad else f.ge_drop_good
+            if p_loss and rng.random() < p_loss:
+                self.record("ge_drop", src, dst, bad=bad)
+                return OutboundFaults(drop=True, ge=True)
         if f.drop and rng.random() < f.drop:
             self.record("drop", src, dst)
             return OutboundFaults(drop=True)
@@ -169,6 +212,35 @@ class FaultInjector:
             self.record("reorder", src, dst, ms=round(reorder_s * 1e3, 3))
         return OutboundFaults(drop=False, delay_s=delay_s,
                               duplicate=duplicate, reorder_s=reorder_s)
+
+    def bw_delay_s(self, src: int, dst: int, nbytes: int) -> float:
+        """Token-bucket bandwidth model for one gossip-class message of
+        ``nbytes`` on the directed link (WAN emulation, ROADMAP item
+        3): a size-proportional serialization delay, plus queueing
+        delay once the burst bucket is exhausted.  Draws NO randomness
+        — the schedule is a pure function of the deterministic message
+        sizes and tick times, so bit-reproducibility is free.  0 when
+        the link is uncapped or the run is quiescing."""
+        if self.quiesce:
+            return 0.0
+        f = self.plan.link(src, dst)
+        if not f.bw_kbps:
+            return 0.0
+        rate = f.bw_kbps * 125.0            # kilobits/s -> bytes/s
+        burst = f.bw_burst_kb * 1024.0
+        key = (src, dst)
+        now = self.tick
+        tokens, last = self._bw_state.get(key, (burst, now))
+        tokens = min(
+            burst, tokens + max(now - last, 0.0) * self.tick_seconds * rate
+        )
+        deficit = nbytes - max(tokens, 0.0)
+        tokens -= nbytes
+        self._bw_state[key] = (tokens, now)
+        delay = nbytes / rate
+        if deficit > 0:
+            delay += deficit / rate
+        return min(delay, BW_DELAY_MAX_S)
 
     # ------------------------------------------------------------------
     # byzantine
@@ -191,6 +263,36 @@ class FaultInjector:
 
     def stale_pick(self, node: int, n_cached: int) -> int:
         return self.node_rng(node).randrange(n_cached)
+
+    def is_ts_liar(self, node: int) -> bool:
+        b = self.plan.byzantine
+        return (b is not None and b.mode == "lying_ts"
+                and b.node == node)
+
+    def lying_ts_offset_ns(self, node: int) -> int:
+        """One mint's timestamp lie for the lying_ts actor: 0 (honest
+        mint), or an extreme ±offset uniform in ±LIE_MAX_NS, with
+        probability ``prob`` per mint once the activation tick passed.
+        Drawn from a dedicated seeded stream (like clock_skew), so
+        enabling the actor never shifts any other fault stream's
+        draws.  Suppressed while quiescing so the settle phase
+        converges on honest time."""
+        if self.quiesce or not self.is_ts_liar(node):
+            return 0
+        b = self.plan.byzantine
+        if self.tick < b.at:
+            return 0
+        key = ("liar", node)
+        rng = self._node_rngs.get(key)
+        if rng is None:
+            rng = self._node_rngs[key] = random.Random(
+                f"babble-chaos:{self.seed}:liar:{node}"
+            )
+        if rng.random() >= b.prob:
+            return 0
+        off = int(rng.uniform(-LIE_MAX_NS, LIE_MAX_NS))
+        self.record("lying_ts", node, node)
+        return off
 
     def is_snapshot_forger(self, node: int) -> bool:
         b = self.plan.byzantine

@@ -333,19 +333,26 @@ class InvariantChecker:
             ))
 
     def _check_skew_robust_order(self, scenario, result, report) -> None:
-        """Adversarial time: bounded clock drift must never REORDER two
-        commits that the drift-free twin run orders strictly by
-        (round_received, consensus_ts).  (rr, cts)-TIED commits fall to
-        the whitened-signature tiebreak — deterministic across the
-        fleet within each run, but legitimately different between the
-        two runs, because the drifted timestamps live inside the signed
-        event bodies.  So the claim checked is exactly the ISSUE's:
-        median-timestamp ORDER is unaffected by ±drift within bound."""
-        if scenario.plan.clock_skew is None:
+        """Adversarial time: bounded clock drift — or a lying_ts
+        byzantine minority claiming EXTREME timestamps — must never
+        REORDER two commits that the honest-time twin run orders
+        strictly by (round_received, consensus_ts).  (rr, cts)-TIED
+        commits fall to the whitened-signature tiebreak —
+        deterministic across the fleet within each run, but
+        legitimately different between the two runs, because the
+        drifted/lying timestamps live inside the signed event bodies.
+        So the claim checked is exactly the ISSUE's: median-timestamp
+        ORDER over honest pairs is unaffected by bounded drift, and
+        unperturbed by up to n/3 timestamp liars (the insert-time
+        clamp pins their median contributions into the honest
+        envelope)."""
+        byz = scenario.plan.byzantine
+        lying = byz is not None and byz.mode == "lying_ts"
+        if scenario.plan.clock_skew is None and not lying:
             report.violations.append(Violation(
                 "skew_robust_order",
                 "scenario declares the skew_robust_order invariant but "
-                "drifts no clocks",
+                "drifts no clocks and configures no lying_ts actor",
             ))
             return
         twin = result.noskew_committed
@@ -386,11 +393,16 @@ class InvariantChecker:
                 if bad:
                     break
             if bad:
+                cause = (
+                    f"±{scenario.plan.clock_skew.max_ms} ms drift"
+                    if scenario.plan.clock_skew is not None
+                    else f"the lying_ts actor (node {byz.node})"
+                )
                 report.violations.append(Violation(
                     "skew_robust_order",
-                    f"node {i}: ±{scenario.plan.clock_skew.max_ms} ms "
-                    f"drift reordered two strictly-(rr, cts)-ordered "
-                    f"commits ({bad[2]} vs {bad[3]})",
+                    f"node {i}: {cause} reordered two strictly-"
+                    f"(rr, cts)-ordered commits "
+                    f"({bad[2]} vs {bad[3]})",
                 ))
 
     def _check_fast_forwarded(self, scenario, result, report) -> None:

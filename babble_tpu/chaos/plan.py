@@ -31,10 +31,11 @@ KNOWN_INVARIANTS = (
     "epoch_agreement",    # every honest node applied every membership
                           # transition at the same decided round
     "skew_robust_order",  # committed order identical to the same run
-                          # with clock drift off (cts median robustness)
+                          # with clock drift off / timestamp lying off
+                          # (cts median robustness)
 )
 
-BYZANTINE_MODES = ("fork", "stale_replay", "forge_snapshot")
+BYZANTINE_MODES = ("fork", "stale_replay", "forge_snapshot", "lying_ts")
 
 
 def _prob(v, name: str) -> float:
@@ -53,10 +54,27 @@ def _ms_range(v, name: str) -> Tuple[float, float]:
 
 @dataclass(frozen=True)
 class LinkFaults:
-    """Per-directed-link fault probabilities.  ``delay``/``reorder`` are
+    """Per-directed-link fault probabilities plus WAN-shaped link
+    models (ROADMAP items 3+5).  ``delay``/``reorder`` are
     probabilities; the matching ``*_ms`` ranges bound the injected
     latency (reordering is modeled as extra delay on the affected
-    message relative to the messages behind it)."""
+    message relative to the messages behind it).
+
+    WAN models (both off by default, so pre-existing plans — and their
+    per-link RNG streams — are untouched):
+
+    - **bandwidth**: ``bw_kbps`` (kilobits/s; 0 = unlimited) applies a
+      token-bucket cap with ``bw_burst_kb`` of burst: every
+      gossip-class message pays a size-proportional serialization
+      delay, and messages past the bucket queue behind the deficit.
+      Draws no randomness — the schedule is a pure function of the
+      (deterministic) message sizes and ticks.
+    - **Gilbert–Elliott burst loss**: a two-state good/bad loss chain
+      (``ge_p_gb``/``ge_p_bg`` transition probabilities per message,
+      ``ge_drop_good``/``ge_drop_bad`` loss rates per state), drawn
+      from the same per-link seeded RNG stream as the classic faults —
+      loss arrives in bursts, the shape one lossy WAN hop actually has.
+    """
 
     drop: float = 0.0
     delay: float = 0.0
@@ -64,29 +82,60 @@ class LinkFaults:
     duplicate: float = 0.0
     reorder: float = 0.0
     reorder_ms: Tuple[float, float] = (1.0, 10.0)
+    bw_kbps: float = 0.0
+    bw_burst_kb: float = 64.0
+    ge_p_gb: float = 0.0
+    ge_p_bg: float = 0.0
+    ge_drop_good: float = 0.0
+    ge_drop_bad: float = 1.0
 
     def __post_init__(self):
         _prob(self.drop, "drop")
         _prob(self.delay, "delay")
         _prob(self.duplicate, "duplicate")
         _prob(self.reorder, "reorder")
+        _prob(self.ge_p_gb, "ge_p_gb")
+        _prob(self.ge_p_bg, "ge_p_bg")
+        _prob(self.ge_drop_good, "ge_drop_good")
+        _prob(self.ge_drop_bad, "ge_drop_bad")
+        if self.bw_kbps < 0:
+            raise ValueError(f"bw_kbps must be >= 0, got {self.bw_kbps}")
+        if self.bw_burst_kb <= 0:
+            raise ValueError(
+                f"bw_burst_kb must be positive, got {self.bw_burst_kb}"
+            )
         object.__setattr__(self, "delay_ms",
                            _ms_range(self.delay_ms, "delay_ms"))
         object.__setattr__(self, "reorder_ms",
                            _ms_range(self.reorder_ms, "reorder_ms"))
 
+    @property
+    def ge_enabled(self) -> bool:
+        return self.ge_p_gb > 0
+
     def to_dict(self) -> dict:
-        return {
+        out = {
             "drop": self.drop, "delay": self.delay,
             "delay_ms": list(self.delay_ms),
             "duplicate": self.duplicate, "reorder": self.reorder,
             "reorder_ms": list(self.reorder_ms),
         }
+        # WAN keys ride only when set: pre-WAN plan JSON stays stable
+        if self.bw_kbps:
+            out["bw_kbps"] = self.bw_kbps
+            out["bw_burst_kb"] = self.bw_burst_kb
+        if self.ge_enabled:
+            out["ge_p_gb"] = self.ge_p_gb
+            out["ge_p_bg"] = self.ge_p_bg
+            out["ge_drop_good"] = self.ge_drop_good
+            out["ge_drop_bad"] = self.ge_drop_bad
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "LinkFaults":
         known = {"drop", "delay", "delay_ms", "duplicate", "reorder",
-                 "reorder_ms"}
+                 "reorder_ms", "bw_kbps", "bw_burst_kb", "ge_p_gb",
+                 "ge_p_bg", "ge_drop_good", "ge_drop_bad"}
         extra = set(d) - known
         if extra:
             raise ValueError(f"unknown link fault keys: {sorted(extra)}")
@@ -247,7 +296,11 @@ class ByzantineSpec:
     DOCTORED snapshot — committed history rewritten, digest recomputed
     self-consistently, proof re-signed under the actor's own key — the
     protocol-aware-recovery attack verified fast-forward exists to
-    refuse."""
+    refuse; ``lying_ts`` mints events whose claimed timestamps are
+    EXTREME lies (each mint lies with probability ``prob`` from tick
+    ``at`` on, offsets drawn from a dedicated seeded stream) — the
+    creator-claimed-median ordering attack the per-creator timestamp
+    clamp (core/dag.py TS_CLAMP_WINDOW_NS) exists to absorb."""
 
     node: int
     mode: str = "fork"

@@ -226,14 +226,21 @@ class ScenarioRunner:
     def run(self) -> ScenarioResult:
         result = asyncio.run(self._run())
         sc = self.scenario
-        if (sc.plan.clock_skew is not None
+        byz = sc.plan.byzantine
+        lying = byz is not None and byz.mode == "lying_ts"
+        if ((sc.plan.clock_skew is not None or lying)
                 and "skew_robust_order" in sc.invariants):
             # the invariant is a differential claim: the same (scenario,
-            # seed) with drift OFF must commit the identical order —
-            # median timestamps absorb bounded per-creator skew.  Run
-            # the drift-free twin and re-check.
+            # seed) with adversarial time OFF — clock drift removed,
+            # the lying_ts actor made honest — must commit the same
+            # strict (rr, cts) order.  Median timestamps absorb bounded
+            # per-creator skew, and the insert-time clamp pins a lying
+            # minority's claims into the honest envelope.  Run the
+            # honest-time twin and re-check.
             d = sc.to_dict()
             d["plan"].pop("clock_skew", None)
+            if lying:
+                d["plan"].pop("byzantine", None)
             d["invariants"] = [
                 i for i in d["invariants"] if i != "skew_robust_order"
             ]
@@ -287,7 +294,8 @@ class ScenarioRunner:
         sc = self.scenario
         n = sc.nodes
         seed = self.seed
-        injector = FaultInjector(sc.plan, seed)
+        injector = FaultInjector(sc.plan, seed,
+                                 tick_seconds=sc.tick_seconds)
         rng = random.Random(f"babble-chaos-scenario:{seed}")
         # logical event clock: strictly increasing ns, identical across
         # runs because every event creation happens inside one of the
@@ -352,6 +360,11 @@ class ScenarioRunner:
             # in-memory runner, and its per-insert/ship records are
             # pure overhead on the scenario hot loop
             conf.lineage = False
+            # anchor collection OFF: its background RPC rounds would
+            # cross partitions at timing-dependent moments and perturb
+            # the recorded fault schedule (the node-level anchor tests
+            # own this path; live fleets keep the default interval)
+            conf.anchor_interval = 0
             # positive interval with gossip=False means: syncs only mark
             # the pipeline dirty and the RUNNER decides when consensus
             # runs (a timer task would reintroduce wall-clock
@@ -387,7 +400,18 @@ class ScenarioRunner:
             # rides on the shared logical clock through the Core.now_ns
             # hook — event bodies stay deterministic per (seed, node)
             drift = injector.clock_drift_ns(h.idx)
-            if drift:
+            if injector.is_ts_liar(h.idx):
+                # the lying_ts byzantine actor: per-mint EXTREME claimed
+                # timestamps from a dedicated seeded stream — the
+                # creator-claimed-median attack the insert-time clamp
+                # absorbs.  Still deterministic per (seed, node): every
+                # mint happens inside one of the runner's sequential
+                # awaits.
+                h.node.core.now_ns = (
+                    lambda d=drift, i=h.idx:
+                    clock() + d + injector.lying_ts_offset_ns(i)
+                )
+            elif drift:
                 h.node.core.now_ns = (lambda d=drift: clock() + d)
             else:
                 h.node.core.now_ns = clock
@@ -808,6 +832,58 @@ def run_scenario(scenario: Scenario,
 # live fleets
 
 
+def _live_membership_op(runner, base_dir: str, op, log) -> bool:
+    """Execute one scheduled churn verb against a live subprocess
+    fleet: boot the joiner (spawn_joiner) and submit its subject-signed
+    join tx — or submit a leave tx — through a live node's SubmitTx
+    front door, exactly as an operator would.  The driver holds every
+    scenario key (the datadirs it built), so leaves work even while the
+    leaver is down.  Returns False when the submit should be retried
+    (the via node is still booting/compiling)."""
+    import os
+
+    from ..crypto.keys import PemKeyFile
+    from ..membership.transition import build_membership_tx
+    from ..proxy.jsonrpc import JsonRpcClient, b64e
+    from .. import testnet as tn
+
+    if op.kind == "join":
+        log(f"[chaos] boot joiner node {op.node}")
+        runner.spawn_joiner(op.node)
+    via = op.via if op.via is not None else 0
+    d = os.path.join(base_dir, f"node{op.node}")
+    key = PemKeyFile(d).read()
+    addr = runner.ports.of(op.node)["gossip"]
+    # stamp the CURRENT epoch (pipelined transitions accept stamps from
+    # the current epoch through the projected apply epoch, so a burst
+    # of same-epoch submissions queues cleanly)
+    epoch = 0
+    try:
+        h = tn.fetch_healthz(runner.ports.of(via)["service"])
+        epoch = int(h.get("epoch", 0))
+    except Exception:
+        pass
+    tx = build_membership_tx(op.kind, key, addr, epoch)
+
+    async def _submit() -> None:
+        client = JsonRpcClient(runner.ports.of(via)["submit"],
+                               timeout=15.0)
+        try:
+            await client.call("Babble.SubmitTx", b64e(tx))
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(_submit())
+    except Exception as e:
+        log(f"[chaos] {op.kind} tx for node {op.node} via {via} "
+            f"failed ({e}); will retry")
+        return False
+    log(f"[chaos] submitted {op.kind} tx for node {op.node} "
+        f"via {via} (epoch {epoch})")
+    return True
+
+
 def run_live(
     scenario: Scenario,
     base_dir: str,
@@ -830,13 +906,27 @@ def run_live(
     plan_path = os.path.join(base_dir, "scenario.json")
     with open(plan_path, "w") as f:
         json.dump(scenario.to_dict(), f, indent=1)
+    # exact link identities for every node's injector: gossip address
+    # -> scenario index over founders AND scheduled joiners, so
+    # founder->joiner links carry their planned faults and multiple
+    # joiners never collide on one index (cli --chaos_addrs)
+    ports = tn.PortLayout()
+    addrs_path = os.path.join(base_dir, "chaos_addrs.json")
+    with open(addrs_path, "w") as f:
+        json.dump({
+            ports.of(i)["gossip"]: i
+            for i in range(scenario.nodes + scenario.joiners)
+        }, f, indent=1)
 
     # one shared tick-0 for the whole fleet, restarts included — each
     # node's injector maps wall time to plan ticks from this epoch, so
     # a relaunched node rejoins the schedule in phase
     epoch = time.time()
     runner = tn.TestnetRunner(
-        base_dir, scenario.nodes, heartbeat_ms=20,
+        base_dir, scenario.nodes, heartbeat_ms=20, ports=ports,
+        # membership plane: datadirs for scheduled joiners are prepared
+        # up front; spawn_joiner boots each at its join op's tick
+        joiners=scenario.joiners,
         # generous sync timeout: injected delays ride on top of real
         # RTTs, and byzantine-mode consensus per sync is heavy on
         # oversubscribed hosts — 200 ms would read every slow response
@@ -845,6 +935,7 @@ def run_live(
         extra_node_args=[
             "--chaos_plan", plan_path, "--chaos_seed", str(scenario.seed),
             "--chaos_epoch", repr(epoch),
+            "--chaos_addrs", addrs_path,
         ],
         # crash/restart runs HONEST since the durability plane landed:
         # a killed node replays its per-event WAL on top of the newest
@@ -873,11 +964,60 @@ def run_live(
             daemon=True,
         )
         bomber.start()
+        #: membership churn schedule (live mode): tick -> ops.  A
+        #: failed submit (target node still compiling its first flush)
+        #: re-queues the op a couple of seconds later instead of
+        #: silently dropping the transition; a submit whose epoch stamp
+        #: proves STALE (the fleet applied an earlier transition after
+        #: the stamp was fetched — deterministic reject) is detected by
+        #: the verify pass below and resubmitted with a fresh stamp,
+        #: exactly as an operator's tooling would.
+        member_sched: Dict[int, list] = {}
+        for op in list(scenario.plan.joins) + list(scenario.plan.leaves):
+            member_sched.setdefault(op.tick, []).append(op)
+        #: ordered (op, verify_tick) list of submitted transitions:
+        #: when op k's verify comes due, the fleet must have k+1
+        #: transitions applied or in flight (pending + queue)
+        awaiting: list = []
+        ops_confirmed = 0
+
+        def _epoch_of(node: int) -> int:
+            h = tn.fetch_healthz(runner.ports.of(node)["service"])
+            return int(h.get("epoch", 0))
+
+        def _in_flight(node: int) -> int:
+            """Transitions applied or in flight at ``node``: its epoch
+            plus the pending boundary plus the queued tail — the one
+            definition both the verify pass and the settle loop use."""
+            h = tn.fetch_healthz(runner.ports.of(node)["service"])
+            return (int(h.get("epoch", 0))
+                    + (1 if h.get("epoch_pending") else 0)
+                    + int(h.get("epoch_queue", 0)))
+
         # the driver walks the SAME epoch the nodes' injectors use, so
         # crash/restart actions stay in phase with the plan's partition
         # windows; ticks that elapsed during fleet boot are processed
         # immediately (their sleep clamps to zero)
         for tick in range(scenario.steps):
+            for op in member_sched.pop(tick, []):
+                ok = _live_membership_op(runner, base_dir, op, log)
+                if ok:
+                    awaiting.append((op, tick + 50))
+                elif tick + 20 < scenario.steps:
+                    member_sched.setdefault(tick + 20, []).append(op)
+            while awaiting and awaiting[0][1] <= tick:
+                op, _ = awaiting.pop(0)
+                via = op.via if op.via is not None else 0
+                try:
+                    flight = _in_flight(via)
+                except Exception:
+                    flight = 0
+                if flight >= ops_confirmed + 1:
+                    ops_confirmed += 1
+                elif tick + 20 < scenario.steps:
+                    log(f"[chaos] {op.kind} for node {op.node} did not "
+                        "take (stale stamp?); resubmitting")
+                    member_sched.setdefault(tick + 1, []).append(op)
             for action, node_idx in sched.get(tick, ()):
                 if action == "crash":
                     log(f"[chaos] tick {tick}: crash node {node_idx}")
@@ -898,7 +1038,54 @@ def run_live(
             deadline = epoch + (tick + 1) * scenario.tick_seconds
             time.sleep(max(0.0, deadline - time.time()))
         bomber.join(timeout=30)
-        report["stats"] = tn.watch_once(scenario.nodes, runner.ports)
+        total = scenario.nodes + scenario.joiners
+        n_ops = len(scenario.plan.joins) + len(scenario.plan.leaves)
+        if n_ops:
+            # membership settle (the live analog of the deterministic
+            # runner's settle rounds): transitions submitted late in
+            # the run still need their epoch boundary DECIDED, and an
+            # oversubscribed CPU fleet decides rounds slowly while the
+            # bombard load runs — poll (and re-drive any op the verify
+            # loop left unconfirmed) until every reachable node applied
+            # every scheduled transition, or the settle budget runs out
+            all_ops = (list(scenario.plan.joins)
+                       + list(scenario.plan.leaves))
+            deadline = time.time() + 90.0
+            next_redrive = 0.0
+            while time.time() < deadline:
+                views, flights = [], []
+                for i in range(total):
+                    try:
+                        views.append(_epoch_of(i))
+                        flights.append(_in_flight(i))
+                    except Exception:
+                        pass
+                if views and all(v >= n_ops for v in views):
+                    break
+                if (flights and max(flights) < n_ops
+                        and time.time() >= next_redrive):
+                    # some transition neither applied nor in flight
+                    # anywhere (a stale stamp was deterministically
+                    # rejected): re-drive every op — duplicates of
+                    # applied ones are rejected identically everywhere,
+                    # so re-driving is idempotent
+                    for op in all_ops:
+                        _live_membership_op(runner, base_dir, op, log)
+                    next_redrive = time.time() + 15.0
+                time.sleep(2.0)
+        report["stats"] = tn.watch_once(total, runner.ports)
+        if n_ops:
+            # membership plane: the fleet-wide epoch view — live churn's
+            # pass/fail surface (every reachable node must have applied
+            # every scheduled transition)
+            epochs: Dict[str, object] = {}
+            for i in range(total):
+                try:
+                    h = tn.fetch_healthz(runner.ports.of(i)["service"])
+                    epochs[str(i)] = int(h.get("epoch", 0))
+                except Exception as e:
+                    epochs[str(i)] = f"error: {e}"
+            report["epochs"] = epochs
         faults: Dict[str, Dict[str, float]] = {}
         for i in range(scenario.nodes):
             addr = runner.ports.of(i)["service"]

@@ -231,6 +231,58 @@ CANNED: Dict[str, dict] = {
             "clock_skew": {"max_ms": 0.4},
         },
     },
+    # adversarial time, second slice (ROADMAP item 5 matrix): one
+    # byzantine creator CLAIMS extreme timestamps (±up to an hour, far
+    # outside any honest clamp window) on half its mints.  Consensus
+    # timestamps are creator-claimed medians, so without the
+    # insert-time clamp (core/dag.py TS_CLAMP_WINDOW_NS) this skews
+    # round-received medians and permutes the committed order.  The
+    # skew_robust_order invariant runs the honest-time twin (same
+    # scenario, actor removed) and asserts no strictly-(rr, cts)-
+    # ordered honest pair was reordered — the n/3-liar claim, checked
+    # differentially.
+    "lying-ts": {
+        "name": "lying-ts",
+        "nodes": 4, "steps": 240, "seed": 61,
+        "txs": 16, "tx_every": 8,
+        "invariants": ["prefix_agreement", "liveness", "all_committed",
+                       "skew_robust_order"],
+        "plan": {
+            "default": {"drop": 0.05},
+            "byzantine": {"node": 1, "mode": "lying_ts",
+                          "at": 10, "prob": 0.5},
+        },
+    },
+    # WAN-shaped links (ROADMAP items 3+5): every link carries a
+    # token-bucket bandwidth cap with size-proportional serialization
+    # delay plus Gilbert–Elliott burst loss, and one directed pair is
+    # a thin transcontinental hop — the instrument that lets one host
+    # emulate WAN topology honestly.  The fleet must keep committing
+    # and agreeing through bursty loss and bandwidth queueing.
+    "wan-lossy": {
+        "name": "wan-lossy",
+        "nodes": 4, "steps": 280, "seed": 59,
+        "txs": 16, "tx_every": 10, "liveness_bound": 140,
+        "invariants": ["prefix_agreement", "liveness", "all_committed"],
+        "plan": {
+            "default": {
+                "bw_kbps": 8000, "bw_burst_kb": 32,
+                "ge_p_gb": 0.04, "ge_p_bg": 0.35,
+                "ge_drop_good": 0.01, "ge_drop_bad": 0.85,
+                "delay": 0.15, "delay_ms": [1, 4],
+            },
+            "overrides": [
+                {"src": 0, "dst": 3, "bw_kbps": 1500, "bw_burst_kb": 16,
+                 "ge_p_gb": 0.08, "ge_p_bg": 0.3,
+                 "ge_drop_good": 0.02, "ge_drop_bad": 0.9,
+                 "delay": 0.3, "delay_ms": [2, 6]},
+                {"src": 3, "dst": 0, "bw_kbps": 1500, "bw_burst_kb": 16,
+                 "ge_p_gb": 0.08, "ge_p_bg": 0.3,
+                 "ge_drop_good": 0.02, "ge_drop_bad": 0.9,
+                 "delay": 0.3, "delay_ms": [2, 6]},
+            ],
+        },
+    },
     # a stale-sync replayer answers a sampled fraction of inbound syncs
     # with cached old state; dedup-by-hash must shrug it off
     "stale-replay": {

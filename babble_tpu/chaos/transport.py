@@ -137,8 +137,18 @@ class FaultyTransport(Transport):
             raise TransportError(f"chaos: partitioned from {target}")
         act = inj.outbound(src, dst)
         if act.drop:
-            self._count("drop")
+            self._count("ge_drop" if act.ge else "drop")
             raise TransportError(f"chaos: dropped sync to {target}")
+        # WAN bandwidth model (token bucket + size-proportional
+        # serialization): sized from the command's cheap host-side
+        # estimate — the same seam the off-loop codec uses — so the
+        # model never encodes anything just to measure it
+        bw_s = inj.bw_delay_s(src, dst, req.approx_size())
+        if bw_s > 0:
+            inj.record("bw_delay", src, dst,
+                       ms=round(bw_s * 1e3, 3))
+            self._count("bw_delay")
+            await asyncio.sleep(bw_s)
         if act.delay_s > 0:
             self._count("delay")
             await asyncio.sleep(act.delay_s)

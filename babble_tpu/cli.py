@@ -181,6 +181,7 @@ async def _run_node(args) -> int:
                   if getattr(args, "inactive_rounds", -1) > 0 else 32)
         ),
         ff_verify=not getattr(args, "no_ff_verify", False),
+        anchor_interval=getattr(args, "anchor_interval", 2048),
         bootstrap_peers=bootstrap_peers,
         byzantine=args.byzantine,
         fork_k=args.fork_k,
@@ -302,17 +303,51 @@ def _chaos_wrap(transport, args, key, peers):
 
     with open(args.chaos_plan) as f:
         spec = json.load(f)
+    joiners = 0
     if "plan" in spec:
         sc = Scenario.from_dict(spec)
         plan, tick_seconds, seed = sc.plan, sc.tick_seconds, sc.seed
+        joiners = sc.joiners
     else:
         plan, tick_seconds, seed = FaultPlan.from_dict(spec), 0.05, 0
     if getattr(args, "chaos_seed", None) is not None:
         seed = args.chaos_seed
-    ids = canonical_ids(peers)
-    addr_index = {p.net_addr: ids[p.pub_key_hex] for p in peers}
-    own = ids[key.pub_hex]
-    plan.validate(len(peers))
+    # Link identities: the fleet DRIVER's address map when provided
+    # (--chaos_addrs, written by chaos run --live next to the scenario
+    # JSON) — the only exact source once joiners exist, because it
+    # names every scheduled joiner's address/index BEFORE the joiner's
+    # transition commits, so founders apply link faults on
+    # founder->joiner traffic too and multiple joiners cannot collide
+    # on one index.  Without it, fall back to canonical ids over the
+    # FOUNDING set (a joiner's peers.json carries its own address too,
+    # and folding that key into the sort would renumber every
+    # founder's per-link fault stream); extra address-book entries
+    # take the joiner indices in address order — exact only for a
+    # single joiner, so hand-rolled multi-joiner fleets should pass
+    # --chaos_addrs.
+    addrs_path = getattr(args, "chaos_addrs", "")
+    bp_path = getattr(args, "bootstrap_peers", "")
+    if bp_path:
+        from .net.peers import peers_from_file
+
+        founders = peers_from_file(bp_path)
+    else:
+        founders = peers
+    if addrs_path:
+        with open(addrs_path) as f:
+            addr_index = {a: int(i) for a, i in json.load(f).items()}
+        own = addr_index[transport.local_addr()]
+    else:
+        ids = canonical_ids(founders)
+        addr_index = {p.net_addr: ids[p.pub_key_hex] for p in founders}
+        extra = sorted(
+            p.net_addr for p in peers if p.pub_key_hex not in ids
+        )
+        for j, addr in enumerate(extra):
+            addr_index[addr] = len(founders) + j
+        own = (ids[key.pub_hex] if key.pub_hex in ids
+               else addr_index[transport.local_addr()])
+    plan.validate(len(founders), joiners=joiners)
     # tick 0 is the FLEET's epoch, not this process's boot: a node
     # relaunched mid-run (crash/restart schedule) must rejoin the shared
     # timeline, or it would replay the plan's partition/byzantine
@@ -325,6 +360,10 @@ def _chaos_wrap(transport, args, key, peers):
     injector = FaultInjector(
         plan, seed,
         clock=lambda: (time.time() - epoch) / tick_seconds,
+        # the token bucket's refill clock: elapsed ticks x tick_seconds
+        # must equal elapsed wall seconds, or bandwidth caps refill at
+        # the wrong rate whenever the scenario stretches its timeline
+        tick_seconds=tick_seconds,
     )
     return FaultyTransport(
         transport, injector, own, addr_index,
@@ -842,6 +881,11 @@ def main(argv=None) -> int:
                     help="skip signed-state-proof verification on "
                          "fast-forward snapshots (trust any serving "
                          "peer — the pre-PR-8 model)")
+    rn.add_argument("--anchor_interval", type=int, default=2048,
+                    help="rolling attestation checkpoints: co-sign a "
+                         "CommitDigest anchor with a peer quorum every "
+                         "N commits (joiners verify deep fast-forwards "
+                         "against it); 0 disables collection")
     rn.add_argument("--kernel_class", default="auto",
                     choices=("auto", "latency", "throughput"),
                     help="compiled-surface pin for the fused engine: "
@@ -896,6 +940,12 @@ def main(argv=None) -> int:
                     help="fleet-wide tick-0 (unix seconds) so restarted "
                          "nodes rejoin the shared chaos timeline "
                          "(default: this process's boot time)")
+    rn.add_argument("--chaos_addrs", default="",
+                    help="JSON map of gossip address -> scenario node "
+                         "index (written by chaos run --live): the "
+                         "exact link-identity source once joiners "
+                         "exist; default derives identities from the "
+                         "founding peer set")
     rn.set_defaults(fn=cmd_run)
 
     sm = sub.add_parser("sim", help="batch consensus over a generated DAG")

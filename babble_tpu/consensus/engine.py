@@ -65,6 +65,20 @@ LATENCY_K_MAX = 256
 #: boundary comfortably above the committing flush's own lcr jumps.
 EPOCH_LAG = 2
 
+#: pipelined membership (ROADMAP 5a): max transitions queued behind the
+#: pending epoch boundary.  Mirrors membership.epoch.PIPELINE_WINDOW —
+#: a transition may be stamped up to this many epochs before the one it
+#: applies in, and the chain-of-custody verifier accepts exactly that
+#: window, so the two bounds must agree.
+MEMBERSHIP_QUEUE_MAX = 64
+
+#: bounded membership_log (ROADMAP 5a): entries kept after truncation.
+#: Older entries fold into (membership_base_epoch, membership_addrs);
+#: a joiner whose trusted base predates the retained window must
+#: bootstrap from a fresher base (same contract as the rolling event
+#: window's TooLate).
+MEMBERSHIP_LOG_KEEP = 256
+
 _bucket = bucket
 
 
@@ -107,6 +121,15 @@ class TpuHashgraph:
     pending_membership: Optional[dict] = None
     membership_log: tuple = ()
     membership_rejects = 0
+    # pipelined transitions (ROADMAP 5a): transitions committed while
+    # one is pending queue FIFO instead of being dropped
+    membership_queue: tuple = ()
+    # bounded membership_log: epoch of the newest truncated entry (a
+    # verifier whose trusted base is older cannot bridge the chain and
+    # must bootstrap from a fresher base), plus the gossip addresses of
+    # members whose join entries were truncated
+    membership_base_epoch = 0
+    membership_addrs: dict = {}
 
     def __init__(
         self,
@@ -229,6 +252,14 @@ class TpuHashgraph:
         self.pending_membership: Optional[dict] = None
         self.membership_log: List[dict] = []
         self.membership_rejects = 0
+        #: transitions committed while one is pending, FIFO-applied at
+        #: successive epoch boundaries (pipelined membership): a fleet
+        #: onboarding 50 validators no longer resubmits 49 times
+        self.membership_queue: List[dict] = []
+        #: bounded membership_log: base epoch + truncated-join addrs
+        self.membership_log_keep = MEMBERSHIP_LOG_KEEP
+        self.membership_base_epoch = 0
+        self.membership_addrs: Dict[str, str] = {}
 
         self.consensus = OffsetList()             # hex ids in consensus order
         #: rolling hash chain over the committed order — the attestable
@@ -281,8 +312,9 @@ class TpuHashgraph:
             # (their return must bootstrap through verified fast-forward)
             "evicted_creators": self._evicted_creators_cache,
             # membership plane: current epoch + transitions applied
+            # (== epoch even after the bounded log truncates entries)
             "epoch": self.epoch,
-            "membership_transitions": len(self.membership_log),
+            "membership_transitions": self.epoch,
         }
 
     # ------------------------------------------------------------------
@@ -528,8 +560,7 @@ class TpuHashgraph:
             self.consensus.append(ev.hex())
             self._digest.note(ev.hex())
             self.consensus_transactions += len(ev.transactions)
-            if self.pending_membership is None:
-                self._maybe_schedule_membership(ev)
+            self._maybe_schedule_membership(ev)
         self._ordered_total += len(new_events)
 
         lcr = int(self.state.lcr)
@@ -795,11 +826,14 @@ class TpuHashgraph:
     # membership plane (ISSUE 9): validator join/leave as a consensus op
 
     def _maybe_schedule_membership(self, ev: Event) -> None:
-        """Scan one just-committed event for a valid membership
-        transition tx; the FIRST valid one schedules the transition at
-        boundary rr + EPOCH_LAG.  Runs on the commit path, so every
-        check is deterministic: later transitions that commit while one
-        is pending are dropped identically everywhere (resubmit)."""
+        """Scan one just-committed event for valid membership transition
+        txs.  The first valid one with no transition in flight becomes
+        the pending transition at boundary rr + EPOCH_LAG; later valid
+        ones QUEUE behind it (pipelined membership, ROADMAP 5a) and
+        apply FIFO at successive boundaries — a fleet onboarding 50
+        validators no longer resubmits 49 times.  Runs on the commit
+        path, so every check is deterministic: the same tx is queued
+        (or rejected) identically everywhere."""
         from ..membership.transition import (
             MEMBERSHIP_MAGIC, parse_membership_tx,
         )
@@ -812,7 +846,7 @@ class TpuHashgraph:
             if err is not None:
                 self.membership_rejects += 1
                 continue
-            self.pending_membership = {
+            entry = {
                 "kind": spec.kind,
                 "pub": spec.pub_hex,
                 "addr": spec.net_addr,
@@ -820,29 +854,58 @@ class TpuHashgraph:
                 "position": len(self.consensus),
                 "tx": bytes(tx),
             }
-            return
+            if self.pending_membership is None:
+                self.pending_membership = entry
+            else:
+                self.membership_queue.append(entry)
+
+    def _in_flight_membership(self) -> List[dict]:
+        head = [self.pending_membership] if self.pending_membership else []
+        return head + list(self.membership_queue)
 
     def _validate_membership(self, spec) -> Optional[str]:
         """Deterministic admissibility of a parsed transition against
-        the CURRENT epoch's state (commit-time: every honest node
-        evaluates the same tx at the same epoch)."""
+        the PROJECTED epoch state — the current peer set with every
+        in-flight (pending + queued) transition applied — because that
+        is the state the transition will actually apply in.  The epoch
+        stamp may name any epoch from the current one through the
+        projected apply epoch (pipelined membership): a batch of joins
+        all stamped with the submission-time epoch pipelines cleanly,
+        while a STALE stamp (below the current epoch — e.g. a replayed
+        old leave after the subject rejoined) is still rejected on
+        every replica identically."""
         if spec is None:
             return "unparseable transition"
-        if spec.epoch != self.epoch:
+        queue = self._in_flight_membership()
+        if len(queue) >= MEMBERSHIP_QUEUE_MAX:
+            return "transition queue full"
+        apply_epoch = self.epoch + len(queue)
+        if not (self.epoch <= spec.epoch <= apply_epoch):
             return (
-                f"transition stamped epoch {spec.epoch}, "
-                f"current epoch {self.epoch}"
+                f"transition stamped epoch {spec.epoch}, valid range "
+                f"[{self.epoch}, {apply_epoch}]"
             )
+        # projected membership: current sets plus the in-flight queue
+        known = set(self.participants)
+        active = {
+            pub for pub, cid in self.participants.items()
+            if cid not in self.cfg.retired
+        }
+        for q in queue:
+            if q["kind"] == "join":
+                known.add(q["pub"])
+                active.add(q["pub"])
+            else:
+                active.discard(q["pub"])
         if spec.kind == "join":
-            if spec.pub_hex in self.participants:
-                return "join for an existing participant"
+            if spec.pub_hex in known:
+                return "join for an existing or queued participant"
         else:
-            cid = self.participants.get(spec.pub_hex)
-            if cid is None:
+            if spec.pub_hex not in known:
                 return "leave for an unknown participant"
-            if cid in self.cfg.retired:
-                return "leave for an already-retired participant"
-            if self.cfg.active_n - 1 < 2:
+            if spec.pub_hex not in active:
+                return "leave for a retired or already-leaving participant"
+            if len(active) - 1 < 2:
                 return "leave would drop the fleet below 2 members"
         if not spec.verify():
             return "bad subject signature"
@@ -892,8 +955,19 @@ class TpuHashgraph:
             old_cfg, new_cfg, self.state, boundary
         )
         self.cfg = new_cfg
+        # jnp.array, NOT jnp.asarray: the transition passes untouched
+        # fields through as ZERO-COPY numpy views of the old device
+        # buffers, and jnp.asarray would alias them right back into the
+        # new state — which the next live_flush DONATES, freeing memory
+        # the old arrays still own (on CPU, where donation is real as
+        # of jax 0.4.x, this corrupted the heap: live churn found
+        # creator columns full of garbage followed by glibc aborts —
+        # the deterministic runner was shielded only because its busy
+        # fleets always trigger the rescan below, whose XLA outputs
+        # launder the aliasing).  An epoch transition is rare; the copy
+        # is noise.
         self.state = DagState(
-            **{k: jnp.asarray(v) for k, v in arrays.items()}
+            **{k: jnp.array(v) for k, v in arrays.items()}
         )
         self._view = {}
         self._aot = {}   # executables were compiled for the old config
@@ -919,7 +993,38 @@ class TpuHashgraph:
             "cid": cid,
             "tx": spec["tx"],
         })
+        self._truncate_membership_log()
         self.pending_membership = None
+        if self.membership_queue:
+            # pipelined membership: promote the next queued transition.
+            # Its boundary must clear the one just applied (held commits
+            # above it re-decide under THIS epoch first); the provisional
+            # rr + EPOCH_LAG stands when it already does.
+            nxt = dict(self.membership_queue.pop(0))
+            nxt["boundary"] = max(nxt["boundary"], boundary + 1)
+            self.pending_membership = nxt
+
+    def _truncate_membership_log(self) -> None:
+        """Bound membership_log growth (ROADMAP 5a): fold entries past
+        the retention window into (membership_base_epoch,
+        membership_addrs).  The signed chain of custody then starts at
+        the base — a verifier whose trusted set predates it must
+        bootstrap from a fresher base (membership/epoch.py rejects the
+        bridge explicitly), exactly the rolling-window contract the
+        event history already has."""
+        keep = self.membership_log_keep
+        if not keep or len(self.membership_log) <= keep:
+            return
+        cut = self.membership_log[:-keep]
+        for e in cut:
+            if e["kind"] == "join":
+                # a truncated join's gossip address must survive: the
+                # embedded signed tx is gone, and nodes restoring from
+                # this engine's checkpoints still need to dial the
+                # member (node._sync_membership reconciles from here)
+                self.membership_addrs[e["pub"]] = e["addr"]
+        self.membership_base_epoch = cut[-1]["epoch"]
+        self.membership_log = self.membership_log[-keep:]
 
     def _level_sched(self, sus: np.ndarray) -> np.ndarray:
         """Level-grouped rescan schedule for local slots ``sus`` (the

@@ -74,6 +74,9 @@ class OracleHashgraph:
     _round_memo: Dict[str, int] = field(default_factory=dict)
     _fame_decided: Dict[Tuple[int, str], bool] = field(default_factory=dict)
     _wire_info: Dict[str, Tuple[int, int, int, int]] = field(default_factory=dict)
+    #: clamp-enforced effective timestamps (adversarial-ts defense) —
+    #: the values _median_timestamp consumes, mirroring core/dag.py
+    _eff_ts: Dict[str, int] = field(default_factory=dict)
 
     def __post_init__(self):
         self.reverse_participants = {v: k for k, v in self.participants.items()}
@@ -217,6 +220,23 @@ class OracleHashgraph:
         self.store.set_event(event)
         self._coords[event.hex()] = coords
         self._update_ancestor_first_descendant(event, coords)
+        # adversarial-ts defense: the same per-creator timestamp clamp
+        # the device engines apply at insert (core/dag.py) — medians
+        # must read the identical effective values or the oracle stops
+        # being the differential ground truth
+        from ..core.dag import TS_CLAMP_WINDOW_NS
+
+        claimed = event.body.timestamp
+        refs = [self._eff_ts[p] for p in
+                (event.self_parent, event.other_parent)
+                if p in self._eff_ts]
+        if refs:
+            ref = max(refs)
+            self._eff_ts[event.hex()] = min(
+                max(claimed, ref + 1), ref + TS_CLAMP_WINDOW_NS
+            )
+        else:
+            self._eff_ts[event.hex()] = claimed
 
         self.undetermined_events.append(event.hex())
 
@@ -490,7 +510,12 @@ class OracleHashgraph:
         return self.store.known()
 
     def _median_timestamp(self, hashes: List[str]) -> int:
-        ts = sorted(self.store.get_event(h).body.timestamp for h in hashes)
+        # effective (clamp-enforced) timestamps, not the raw claims —
+        # the adversarial-ts defense's single seam, like dag.eff_ts
+        ts = sorted(
+            self._eff_ts.get(h, self.store.get_event(h).body.timestamp)
+            for h in hashes
+        )
         return ts[len(ts) // 2]
 
     def _middle_bit(self, hex_id: str) -> bool:

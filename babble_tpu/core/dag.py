@@ -40,6 +40,22 @@ class InsertError(ValueError):
     pass
 
 
+#: Adversarial-timestamp defense (ROADMAP item 5 matrix): the width of
+#: the per-event claimed-timestamp window.  A creator-claimed timestamp
+#: is clamped at insert into ``[parent_max + 1, parent_max + WINDOW]``
+#: where ``parent_max`` is the max *effective* timestamp of the event's
+#: known parents — monotone vs the self-parent chain and bounded vs the
+#: DAG structure the event itself acknowledges.  Honest traffic never
+#: trips either edge (events are minted after their parents, and gossip
+#: paths advance far faster than this window), so effective == claimed
+#: everywhere on an honest fleet — which is what keeps pre-defense
+#: fingerprints bit-identical.  A byzantine creator claiming extreme
+#: timestamps has its contribution to every round-received median pinned
+#: into the honest envelope instead, so a lying minority cannot skew
+#: consensus timestamps (the `lying-ts` chaos scenario pins this).
+TS_CLAMP_WINDOW_NS = 600_000_000_000  # 10 min of ns
+
+
 @dataclass
 class HostDag:
     participants: Dict[str, int]              # pub hex -> id
@@ -54,6 +70,12 @@ class HostDag:
     # (sp_index, op_creator_id, op_index) by slot — wire coords captured at
     # insert so conversion survives parent eviction
     wire_meta: OffsetList = field(default_factory=OffsetList)
+    # effective (clamp-enforced) timestamp by slot: the value the device
+    # median kernels consume.  Derived at insert from the claimed body
+    # timestamp and the parents' effective timestamps (TS_CLAMP_WINDOW_NS)
+    # — a pure function of the event's own ancestry, so it is identical
+    # on every replica and never touches the signed bytes.
+    eff_ts: OffsetList = field(default_factory=OffsetList)
     chains: List[OffsetList] = field(init=False)               # creator -> slots
     pending: List[int] = field(default_factory=list)           # unflushed slots
     # per-creator eviction horizon: cid -> (index, hex) of the NEWEST
@@ -178,12 +200,34 @@ class HostDag:
                 self.levels[sps] if sps >= 0 else -1,
                 self.levels[ops] if ops >= 0 else -1,
             )
+        # Per-creator timestamp sanity (adversarial-time defense): the
+        # claimed timestamp is clamped into a window derived from the
+        # parents' EFFECTIVE timestamps — strictly monotone past them,
+        # bounded to TS_CLAMP_WINDOW_NS beyond them.  The clamped value
+        # is what the median kernels consume; the signed body keeps the
+        # claim (hashes and signatures are untouched).  Parents outside
+        # the window (pseudo-roots, continuations) contribute nothing —
+        # their subtree's claims were clamped when they were live.
+        claimed = event.body.timestamp
+        parent_ref = None
+        if sps >= 0:
+            parent_ref = self.eff_ts[sps]
+        if ops >= 0:
+            op_eff = self.eff_ts[ops]
+            parent_ref = op_eff if parent_ref is None \
+                else max(parent_ref, op_eff)
+        if parent_ref is None:
+            eff = claimed
+        else:
+            eff = min(max(claimed, parent_ref + 1),
+                      parent_ref + TS_CLAMP_WINDOW_NS)
         self.events.append(event)
         self.slot_of[hex_id] = slot
         self.levels.append(level)
         self.sp_slot.append(sps)
         self.op_slot.append(ops)
         self.wire_meta.append(meta)
+        self.eff_ts.append(eff)
         chain.append(slot)
         self.pending.append(slot)
         return slot
@@ -204,6 +248,7 @@ class HostDag:
         self.sp_slot.evict_to(new_base)
         self.op_slot.evict_to(new_base)
         self.wire_meta.evict_to(new_base)
+        self.eff_ts.evict_to(new_base)
         for chain in self.chains:
             w = chain.window
             # chain slots ascend, so the evicted part is a prefix
@@ -250,7 +295,10 @@ class HostDag:
             op[i] = ops - base if ops >= 0 else -1
             creator[i] = self.participants[ev.creator]
             seq[i] = ev.index
-            ts[i] = ev.body.timestamp
+            # clamp-enforced effective timestamp, not the raw claim:
+            # this is the single seam through which every engine's
+            # median kernels read event time (adversarial-ts defense)
+            ts[i] = self.eff_ts[s]
             mbit[i] = ev.middle_bit()
             lev[i] = self.levels[s]
 

@@ -28,6 +28,16 @@ from .transition import parse_membership_tx
 #: a hostile log must cost nothing to reject
 MAX_LOG = 4096
 
+#: pipelined membership (ROADMAP 5a): a transition may be stamped up to
+#: this many epochs before the epoch it applies in (transitions queued
+#: behind a pending boundary keep their submission-time stamp).  Must
+#: equal consensus.engine.MEMBERSHIP_QUEUE_MAX — the engine never
+#: queues deeper than this, so any wider gap in a log is a forgery.
+#: Replay protection is unchanged in substance: the engine rejects
+#: stamps below its CURRENT epoch at commit time, so a stale leave
+#: still cannot re-remove a member who rejoined epochs ago.
+PIPELINE_WINDOW = 64
+
 
 def check_log_entry(entry: dict) -> Optional[str]:
     """Structural bounds for one serialized membership-log entry
@@ -89,10 +99,13 @@ def replay_log(
             # forged log redirect a validator's gossip address to an
             # attacker-chosen one (eclipse of that link)
             raise ValueError("membership log entry contradicts its tx")
-        if tx.epoch != epoch:
+        if tx.epoch > epoch or epoch - tx.epoch > PIPELINE_WINDOW:
+            # pipelined transitions keep their submission-time stamp:
+            # stamped at or before the epoch they apply FROM, within
+            # the engine's queue bound
             raise ValueError(
                 f"membership tx stamped epoch {tx.epoch}, applied at "
-                f"epoch {epoch}"
+                f"epoch {epoch} (allowed window {PIPELINE_WINDOW})"
             )
         if not tx.verify():
             raise ValueError(
@@ -126,6 +139,17 @@ def verify_membership_chain(
         return (
             f"snapshot epoch {snap_epoch} is behind our epoch "
             f"{base_epoch}"
+        )
+    trunc = int(getattr(engine, "membership_base_epoch", 0) or 0)
+    if base_epoch < trunc:
+        # bounded membership_log: the snapshot truncated the chain
+        # entries our trusted base would need.  Same contract as the
+        # rolling event window's TooLate — bootstrap from a fresher
+        # trusted base (updated bootstrap peers.json) instead.
+        return (
+            f"snapshot membership log is truncated at epoch {trunc}, "
+            f"above our trusted base epoch {base_epoch} — cannot "
+            "bridge the chain of custody"
         )
     log = list(getattr(engine, "membership_log", ()) or ())
     try:

@@ -24,7 +24,7 @@ executor hop costs more than the encode) or on the codec thread.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import msgpack
 
@@ -207,7 +207,16 @@ class StateProofRequest:
     commit digest at ``position``".  Sent by a fast-forward joiner to
     peers OTHER than the snapshot responder; ``n//3 + 1`` matching
     signed digests (responder included) gate snapshot adoption, so a
-    rewritten history needs a byzantine quorum to install."""
+    rewritten history needs a byzantine quorum to install.
+
+    ``anchor=1`` asks instead for the peer's newest ROLLING ATTESTATION
+    CHECKPOINT at or below ``position`` — a quorum-co-signed
+    ``(position, digest, epoch)`` anchor collected every
+    ``Config.anchor_interval`` commits (node._collect_anchor).  A
+    joiner whose snapshot extends beyond every live attester's frontier
+    falls back to it: the anchor's signature set is verifiable offline,
+    so the commit suffix from the anchor to the signed head re-folds
+    against quorum-backed history instead of responder trust alone."""
 
     from_addr: str
     position: int
@@ -215,17 +224,22 @@ class StateProofRequest:
     #: and a mismatch at the same position is a reject (an attestation
     #: from the wrong epoch cannot vouch for this peer set)
     epoch: int = 0
+    #: 1 = serve the newest quorum-signed anchor <= position instead of
+    #: a live attestation (rolling attestation checkpoints)
+    anchor: int = 0
 
     def pack(self) -> bytes:
-        return msgpack.packb([self.from_addr, self.position, self.epoch],
-                             use_bin_type=True)
+        return msgpack.packb(
+            [self.from_addr, self.position, self.epoch, self.anchor],
+            use_bin_type=True)
 
     @classmethod
     def unpack(cls, data: bytes) -> "StateProofRequest":
         fields = msgpack.unpackb(data, raw=False)
         epoch = fields[2] if len(fields) > 2 else 0
+        anchor = fields[3] if len(fields) > 3 else 0
         return cls(from_addr=fields[0], position=int(fields[1]),
-                   epoch=int(epoch))
+                   epoch=int(epoch), anchor=int(anchor))
 
     def approx_size(self) -> int:
         return 64
@@ -236,7 +250,13 @@ class StateProofResponse:
     """Attestation: the responder's commit digest at the requested
     position, signed with its participant key.  ``digest == ""`` means
     "unknown" — the position is ahead of this peer or rolled off its
-    retained digest history — and never counts toward the quorum."""
+    retained digest history — and never counts toward the quorum.
+
+    ``anchor`` (anchor-mode requests only) carries one quorum-signed
+    rolling attestation checkpoint as ``[position, digest, epoch,
+    [[pub_hex, r, s], ...]]`` — every signature an independent
+    ``sign_attestation`` over the same (position, digest, epoch), so
+    the bundle verifies offline against the peer set."""
 
     from_addr: str
     position: int
@@ -245,11 +265,20 @@ class StateProofResponse:
     sig_s: int = 0
     #: attester's consensus epoch, bound into the signature
     epoch: int = 0
+    #: quorum-signed anchor bundle (None = no anchor available)
+    anchor: Optional[list] = None
 
     def pack(self) -> bytes:
+        anchor = None
+        if self.anchor is not None:
+            pos, digest, epoch, sigs = self.anchor
+            anchor = [pos, digest, epoch,
+                      [[pub, _sig_out(r), _sig_out(s)]
+                       for pub, r, s in sigs]]
         return msgpack.packb(
             [self.from_addr, self.position, self.digest,
-             _sig_out(self.sig_r), _sig_out(self.sig_s), self.epoch],
+             _sig_out(self.sig_r), _sig_out(self.sig_s), self.epoch,
+             anchor],
             use_bin_type=True,
         )
 
@@ -257,12 +286,20 @@ class StateProofResponse:
     def unpack(cls, data: bytes) -> "StateProofResponse":
         fields = msgpack.unpackb(data, raw=False)
         epoch = fields[5] if len(fields) > 5 else 0
+        anchor = fields[6] if len(fields) > 6 else None
+        if anchor is not None:
+            pos, digest, aepoch, sigs = anchor
+            anchor = [int(pos), digest, int(aepoch),
+                      [[pub, _sig_in(r), _sig_in(s)]
+                       for pub, r, s in sigs]]
         return cls(from_addr=fields[0], position=int(fields[1]),
                    digest=fields[2], sig_r=_sig_in(fields[3]),
-                   sig_s=_sig_in(fields[4]), epoch=int(epoch))
+                   sig_s=_sig_in(fields[4]), epoch=int(epoch),
+                   anchor=anchor)
 
     def approx_size(self) -> int:
-        return 192
+        return 192 + (0 if self.anchor is None
+                      else 128 * len(self.anchor[3]))
 
 
 RPC_PUSH = 2

@@ -148,6 +148,15 @@ class Config:
     # None = n//3 + 1: any such set contains an honest signer while
     # fewer than a third of participants are byzantine.
     ff_proof_quorum: int | None = None
+    # Rolling attestation checkpoints (ROADMAP item 5): every
+    # anchor_interval commits the node gathers an attestation quorum
+    # for the (position, digest) anchor it just crossed and keeps the
+    # co-signed bundle in a bounded ring, served over the StateProof
+    # RPC.  A joiner whose snapshot extends beyond every live
+    # attester's frontier verifies the commit suffix against the
+    # newest anchor instead of failing the quorum (the PR-8 bootstrap
+    # residual).  0 disables collection (serving/verifying stays on).
+    anchor_interval: int = 2048
     # ---- membership plane (ISSUE 9) ----
     # Epoch-0 validator set when it differs from the gossip address
     # book: a JOINER boots knowing the founding peers (its consensus

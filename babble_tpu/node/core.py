@@ -193,6 +193,9 @@ class Core:
         # not raised — surfaced via insert_failures for stats/tests
         self.insert_failures = 0
         self.last_insert_error: Optional[str] = None
+        #: merge mints skipped because the sync partner's head was
+        #: minted by a creator retired in the current epoch
+        self.retired_merge_skips = 0
         # self-stabilizing gossip (ADVICE r3 medium, layer 3): count-skip
         # diffs can hide the symmetric difference under equivocation.
         # The fork engine's tip exchange makes a hidden divergence
@@ -306,6 +309,11 @@ class Core:
                 "babble_insert_failures",
                 "per-event insert failures tolerated in byzantine mode",
             ).set_function(lambda: self.insert_failures)
+            registry.gauge(
+                "babble_retired_merge_skips",
+                "merge mints skipped because the sync partner's head "
+                "was minted by a retired creator",
+            ).set_function(lambda: self.retired_merge_skips)
             if byzantine:
                 registry.gauge(
                     "babble_forked_creators",
@@ -968,6 +976,16 @@ class Core:
             # probe still negotiating).  Returning False tells the node
             # the payload never rode a self-event, so it requeues.
             return False
+        if other_head and self._head_creator_retired(other_head):
+            # membership plane: never mint a merge on a RETIRED
+            # creator's head — an honest leaver stops minting at its
+            # boundary, so a post-boundary head from it is spam, and a
+            # merge naming it would weave that spam into honest
+            # ancestry (forcing every peer to accept it forever).
+            # The payload re-queues and rides the next exchange.
+            self.retired_merge_skips += 1
+            self.last_insert_error = "peer head creator retired; merge skipped"
+            return False
         if other_head == "":
             # headless responder: an observer (a joiner waiting on its
             # epoch boundary) or a probe-blocked peer has no chain yet,
@@ -986,6 +1004,18 @@ class Core:
         )
         self.sign_and_insert_self_event(ev)
         return True
+
+    def _head_creator_retired(self, head_hex: str) -> bool:
+        """True when ``head_hex`` is held and its creator's column is
+        retired in the current epoch (the merge gate's predicate)."""
+        slot = self.hg.dag.slot_of.get(head_hex)
+        if slot is None:
+            return False
+        retired = getattr(getattr(self.hg, "cfg", None), "retired", ())
+        if not retired:
+            return False
+        ev = self.hg.dag.events[slot]
+        return self.participants.get(ev.creator) in retired
 
     def add_self_event(self, payload: List[bytes]) -> bool:
         """Self-parent-only event carrying pooled txs (used when there is

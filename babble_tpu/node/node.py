@@ -88,6 +88,11 @@ _KERNEL_CLASSES = ("latency", "throughput")
 PUSH_MAX_EVENTS = 512
 PUSH_MAX_BYTES = 4 * 1024 * 1024
 
+#: rolling attestation checkpoints kept (newest last) — enough depth
+#: that a joiner's snapshot window always spans one, tiny enough that
+#: the ring is noise in the node's footprint
+ANCHOR_RING = 8
+
 
 class FFProofError(Exception):
     """A fast-forward snapshot failed signed-state-proof verification
@@ -239,10 +244,17 @@ class Node:
         self.core_lock = asyncio.Lock()
         self.peer_selector = RandomPeerSelector(peers, local_addr)
         #: membership-log entries already reconciled into the node's
-        #: address maps / selector / metrics (index into engine log;
-        #: the log is consensus state, so the prefix is stable across
-        #: fast-forward engine swaps)
-        self._membership_seen = 0
+        #: address maps / selector / metrics, tracked by EPOCH (epochs
+        #: are strictly increasing, so the cursor survives both engine
+        #: swaps AND the bounded log's truncation — an entry index
+        #: would go stale the first time the log trims its head)
+        self._membership_seen_epoch = 0
+        #: rolling attestation checkpoints (ROADMAP item 5): bounded
+        #: ring of quorum-co-signed CommitDigest anchors, newest last.
+        #: Each entry: position, digest, epoch, sigs=[(pub, r, s), ...]
+        self._anchors: List[dict] = []
+        self._anchor_target = 0       # newest position already attempted
+        self._anchor_collecting = False
         # heartbeat pacing draws from a per-identity seeded stream, not
         # the process-global RNG (found by the consensus-nondeterminism
         # taint pass): the jitter exists to desynchronize heartbeats
@@ -312,6 +324,28 @@ class Node:
             "fast-forward snapshots refused because the signed state "
             "proof was missing, invalid, inconsistent with the snapshot "
             "bytes, or short of the attestation quorum")
+        # rolling attestation checkpoints (ROADMAP item 5)
+        self._m_anchor_collected = m.counter(
+            "babble_anchor_checkpoints_total",
+            "rolling attestation checkpoints collected (a quorum "
+            "co-signed one CommitDigest anchor)")
+        self._m_ff_anchor_adopts = m.counter(
+            "babble_ff_anchor_verifies_total",
+            "fast-forward adoptions that verified the commit suffix "
+            "against a rolling attestation checkpoint because the "
+            "live attestation quorum was unreachable")
+        m.gauge(
+            "babble_anchor_position",
+            "committed position of the newest quorum-signed rolling "
+            "attestation checkpoint held (0 = none yet)",
+        ).set_function(
+            lambda: self._anchors[-1]["position"] if self._anchors else 0)
+        # transport-level drop of retired creators (membership plane)
+        self._m_retired_rejects = m.counter(
+            "babble_retired_ingress_rejects_total",
+            "inbound pushes refused because the sender's creator key "
+            "is retired in the current epoch (plus merge mints "
+            "skipped on a retired peer's head)")
         self._m_sync_seconds = m.histogram(
             "babble_sync_seconds",
             "insert+mint wall time per applied sync response")
@@ -628,6 +662,7 @@ class Node:
             "reasons": reasons,
             "probe_armed": bool(core.probing),
             "epoch_pending": bool(pending),
+            "epoch_queue": len(getattr(hg, "membership_queue", ())),
             "epoch": int(snap.get("epoch", 0)),
             "lcr": int(snap.get("last_consensus_round", -1)),
             "commit_length": int(getattr(hg, "commit_length", 0)),
@@ -657,11 +692,32 @@ class Node:
         metrics with the engine's membership log (membership plane).
         Called after every consensus run and after any engine swap —
         the log is consensus state, so entries arrive in the same order
-        on every node, and processing is idempotent per index."""
-        log = getattr(self.core.hg, "membership_log", ())
-        while self._membership_seen < len(log):
-            entry = log[self._membership_seen]
-            self._membership_seen += 1
+        on every node, and processing is idempotent per epoch."""
+        hg = self.core.hg
+        # bounded membership_log: entries below the engine's base epoch
+        # are truncated — their join ADDRESSES survive on the engine
+        # (membership_addrs).  Fill only gaps: a gossip address we
+        # already resolved must never be redirected by adopted state.
+        base = int(getattr(hg, "membership_base_epoch", 0) or 0)
+        if base > self._membership_seen_epoch:
+            for pub, addr in getattr(hg, "membership_addrs", {}).items():
+                if addr in self._addr_pub:
+                    continue
+                self._addr_pub[addr] = pub
+                cid = self.core.participants.get(pub)
+                if cid is not None:
+                    self._addr_cid[addr] = cid
+                    if cid not in getattr(
+                            getattr(hg, "cfg", None), "retired", ()):
+                        self.peer_selector.add_peer(
+                            Peer(net_addr=addr, pub_key_hex=pub)
+                        )
+            self._membership_seen_epoch = base
+        log = getattr(hg, "membership_log", ())
+        for entry in log:
+            if entry["epoch"] <= self._membership_seen_epoch:
+                continue
+            self._membership_seen_epoch = entry["epoch"]
             self._m_transitions.inc()
             pub, addr, kind = entry["pub"], entry["addr"], entry["kind"]
             self.flight.note("epoch_apply", epoch=entry["epoch"],
@@ -707,6 +763,121 @@ class Node:
                         entry["epoch"], pub[:18], entry["boundary"],
                     )
             self.core.refresh_quorums()
+
+    # ------------------------------------------------------------------
+    # rolling attestation checkpoints (ROADMAP item 5): every
+    # anchor_interval commits, gather an attestation quorum for the
+    # (position, digest) anchor just crossed and keep the co-signed
+    # bundle in a bounded ring.  The bundle is the portable proof a
+    # fast-forward joiner verifies OFFLINE when every live attester's
+    # frontier is below the snapshot — the PR-8 bootstrap residual.
+
+    def _maybe_collect_anchor(self) -> None:
+        """Called after each consensus run (under the core lock — reads
+        host mirrors only).  Launches at most one collection task."""
+        k = self.conf.anchor_interval
+        if not k or self._anchor_collecting or self.core._observer:
+            return
+        hg = self.core.hg
+        length = int(getattr(hg, "commit_length", 0))
+        target = (length // k) * k
+        if target <= self._anchor_target or target <= 0:
+            return
+        digest = None
+        if hasattr(hg, "commit_digest_at"):
+            digest = hg.commit_digest_at(target)
+        if digest is None:
+            # rolled off the retained per-position history before we
+            # got here (deep catch-up): skip to the next boundary
+            self._anchor_target = target
+            return
+        self._anchor_collecting = True
+        t = asyncio.create_task(
+            self._collect_anchor(target, digest,
+                                 int(getattr(hg, "epoch", 0)))
+        )
+        self._aux_tasks.add(t)
+        t.add_done_callback(self._aux_tasks.discard)
+
+    async def _collect_anchor(self, position: int, digest: str,
+                              epoch: int) -> None:
+        """Ask every peer to co-sign the anchor over the existing
+        StateProof RPC; a quorum of matching signatures (ours included)
+        makes it a rolling attestation checkpoint."""
+        from ..membership.quorum import attestation_quorum
+        from ..store.proof import sign_attestation, verify_attestation
+
+        try:
+            local = self.transport.local_addr()
+            own_r, own_s = sign_attestation(
+                self.core.key, position, digest, epoch
+            )
+            sigs = [(self.core.pub_hex, own_r, own_s)]
+            needed = attestation_quorum(self.core._active_count())
+            peers = sorted(
+                p.net_addr for p in self.peer_selector.peers()
+                if p.net_addr != local
+            )
+            answers = await asyncio.gather(
+                *(self.transport.request(
+                    peer,
+                    StateProofRequest(from_addr=local, position=position,
+                                      epoch=epoch),
+                    timeout=self.conf.tcp_timeout,
+                ) for peer in peers),
+                return_exceptions=True,
+            )
+            seen = {self.core.pub_hex}
+            for peer, att in zip(peers, answers):
+                if isinstance(att, BaseException):
+                    if isinstance(att, asyncio.CancelledError):
+                        raise att
+                    continue
+                pub = self._addr_pub.get(peer)
+                if (pub is None or pub in seen or not att.digest
+                        or att.position != position
+                        or att.digest != digest
+                        or att.epoch != epoch):
+                    continue
+                if verify_attestation(pub, position, digest,
+                                      att.sig_r, att.sig_s, epoch):
+                    seen.add(pub)
+                    sigs.append((pub, att.sig_r, att.sig_s))
+            self._anchor_target = position
+            if len(sigs) >= needed:
+                self._anchors.append({
+                    "position": position, "digest": digest,
+                    "epoch": epoch, "sigs": sigs,
+                })
+                del self._anchors[:-ANCHOR_RING]
+                self._m_anchor_collected.inc()
+                self.flight.note("anchor", position=position,
+                                 signers=len(sigs))
+            else:
+                # short of quorum (partition, laggards): the NEXT
+                # boundary retries — anchors are periodic, not precious
+                self.logger.debug(
+                    "anchor at %d short of quorum (%d/%d)",
+                    position, len(sigs), needed,
+                )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.warning("anchor collection failed: %s", e)
+        finally:
+            # single-flight guard, same shape as _fast_forwarding: set
+            # before the awaits, cleared here, checked at entry with no
+            # await between check and set
+            self._anchor_collecting = False
+
+    def _serve_anchor(self, position: int) -> Optional[list]:
+        """Newest quorum-signed anchor at or below ``position``, in the
+        wire bundle shape (StateProofResponse.anchor)."""
+        for a in reversed(self._anchors):
+            if a["position"] <= position:
+                return [a["position"], a["digest"], a["epoch"],
+                        [[pub, r, s] for pub, r, s in a["sigs"]]]
+        return None
 
     def init(self) -> None:
         """Create the root event (reference node.go:105-112).  Skipped
@@ -1185,7 +1356,24 @@ class Node:
         a merge event carrying our pooled transactions — the same apply
         path as a pull response, so inbound pushes create events too
         (event creation is no longer bounded by one outbound RPC per
-        heartbeat).  The ack returns our post-insert Known."""
+        heartbeat).  The ack returns our post-insert Known.
+
+        Transport-level drop of retired creators (membership plane): a
+        push FROM a member retired in the current epoch is refused
+        before any decode/insert/mint work — post-boundary, an honest
+        leaver mints nothing (retire_membership blocks it), so its
+        pushes can only carry spam mints or redundant relays, and a
+        merge minted on its head would smuggle the spam into honest
+        ancestry.  Pre-boundary straggler events it minted as a member
+        still arrive through honest relays' frames, so no legitimate
+        history is lost."""
+        cid = self._addr_cid.get(req.from_addr)
+        if cid is not None and cid in getattr(
+                getattr(self.core.hg, "cfg", None), "retired", ()):
+            self._m_retired_rejects.inc()
+            raise ValueError(
+                f"push from retired creator {cid} refused"
+            )
         loop = asyncio.get_running_loop()
         async with self.core_lock:
             payload = self._take_payload()
@@ -1231,7 +1419,7 @@ class Node:
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            self.logger.warning("post-push consensus failed: %s", e)
+            self.logger.warning("post-push consensus failed: %s", e, exc_info=True)
 
     async def _process_fast_forward_request(
         self, req: FastForwardRequest
@@ -1284,9 +1472,19 @@ class Node:
         our current frontier — and the joiner re-folds the snapshot
         window to compare.  Positions rolled off the retained digest
         history answer with an empty digest, which never counts toward
-        anyone's quorum."""
+        anyone's quorum.  ``anchor`` requests are answered from the
+        rolling-attestation-checkpoint ring instead: the newest
+        quorum-co-signed anchor at or below the position (None when
+        the ring holds none — the joiner falls back to another peer)."""
         from ..store.proof import sign_attestation
 
+        if req.anchor:
+            return StateProofResponse(
+                from_addr=self.transport.local_addr(),
+                position=req.position,
+                epoch=int(getattr(self.core.hg, "epoch", 0)),
+                anchor=self._serve_anchor(req.position),
+            )
         async with self.core_lock:
             hg = self.core.hg
             digest = None
@@ -1563,11 +1761,112 @@ class Node:
             ):
                 have += 1
         if have < needed:
-            raise FFProofError(
-                f"attestation quorum not reached: {have}/{needed} "
-                f"matching signed digests for frontier "
-                f"({resp.position}, {resp.digest[:12]}…)"
+            # Rolling attestation checkpoints (the PR-8 residual): the
+            # snapshot extends beyond every live attester's frontier
+            # (or they are unreachable), so the LIVE quorum cannot
+            # form.  Fall back to the newest quorum-co-signed anchor:
+            # its signature set verifies offline against the snapshot's
+            # peer set, and the commit suffix from the anchor to the
+            # signed head re-folds against it.  Forged anchors die in
+            # _verify_ff_anchor with FFProofError.
+            await self._verify_ff_anchor(peer_addr, resp, engine,
+                                         have, needed)
+
+    async def _verify_ff_anchor(self, peer_addr: str,
+                                resp: FastForwardResponse,
+                                engine, have: int, needed: int) -> None:
+        """Verify the snapshot's commit suffix against a rolling
+        attestation checkpoint served by the responder.  Raises
+        FFProofError unless a quorum-co-signed anchor (a) verifies
+        signature-by-signature against the snapshot epoch's peer set,
+        (b) lands inside the snapshot's consensus window at or below
+        the signed frontier, and (c) the window re-folds from our
+        digest anchor THROUGH the co-signed anchor — which, combined
+        with verify_snapshot_digest's window->head re-fold, pins the
+        whole suffix (anchor, head] to quorum-backed history."""
+        from ..consensus.digest import fold
+        from ..membership.quorum import attestation_quorum
+        from ..store.proof import verify_attestation
+
+        local = self.transport.local_addr()
+        try:
+            ans = await self.transport.request(
+                peer_addr,
+                StateProofRequest(from_addr=local,
+                                  position=resp.position,
+                                  epoch=resp.epoch, anchor=1),
+                timeout=self.conf.tcp_timeout,
             )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            raise FFProofError(
+                f"attestation quorum not reached ({have}/{needed}) and "
+                f"no rolling anchor served: {e}"
+            )
+        if ans.anchor is None:
+            raise FFProofError(
+                f"attestation quorum not reached ({have}/{needed}) and "
+                "the responder holds no rolling attestation checkpoint"
+            )
+        a_pos, a_digest, a_epoch, sigs = ans.anchor
+        if not isinstance(a_digest, str) or len(a_digest) != 64 \
+                or len(sigs) > len(engine.participants):
+            raise FFProofError("rolling anchor malformed")
+        if a_epoch > resp.epoch or a_pos > resp.position:
+            raise FFProofError(
+                f"rolling anchor ({a_pos}, epoch {a_epoch}) ahead of "
+                f"the signed frontier ({resp.position}, epoch "
+                f"{resp.epoch})"
+            )
+        dg = engine._digest
+        window = list(engine.consensus)
+        start = getattr(engine.consensus, "start", 0)
+        if not (start <= a_pos <= start + len(window)):
+            raise FFProofError(
+                f"rolling anchor position {a_pos} outside the snapshot "
+                f"window [{start}, {start + len(window)}]"
+            )
+        # the signer set: the snapshot epoch's ACTIVE participants —
+        # validate_ff_snapshot later ties that set to its signed
+        # membership chain before anything is adopted
+        cfg = getattr(engine, "cfg", None)
+        retired = set(getattr(cfg, "retired", ()))
+        active = {
+            pub for pub, cid in engine.participants.items()
+            if cid not in retired
+        }
+        a_needed = attestation_quorum(len(active))
+        good = set()
+        for pub, r, s in sigs:
+            if pub in good or pub not in active:
+                continue
+            if verify_attestation(pub, a_pos, a_digest, r, s, a_epoch):
+                good.add(pub)
+        if len(good) < a_needed:
+            raise FFProofError(
+                f"rolling anchor quorum invalid: {len(good)}/{a_needed} "
+                f"verifiable signatures for ({a_pos}, {a_digest[:12]}…)"
+            )
+        if dg.anchor is None or dg.anchor_pos != start:
+            raise FFProofError(
+                "snapshot window carries no digest anchor to re-fold "
+                "against the rolling checkpoint"
+            )
+        if fold(dg.anchor, window[: a_pos - start]) != a_digest:
+            raise FFProofError(
+                "snapshot consensus window does not re-fold to the "
+                "quorum-signed rolling anchor — committed history at "
+                "or below the checkpoint was rewritten"
+            )
+        self._m_ff_anchor_adopts.inc()
+        self.flight.note("ff_anchor", peer=peer_addr, position=a_pos,
+                         signers=len(good))
+        self.logger.warning(
+            "fast-forward verified against rolling attestation "
+            "checkpoint (%d, %s…, %d signers); live quorum was %d/%d",
+            a_pos, a_digest[:12], len(good), have, needed,
+        )
 
     async def _fast_forward(self, peer_addr: str) -> None:
         """Catch-up: fetch a snapshot and restart consensus from it.
@@ -1848,6 +2147,9 @@ class Node:
             self._commit_queue.put_nowait(new_events)
         # membership plane: the run may have applied an epoch boundary
         self._sync_membership()
+        # rolling attestation checkpoints: commits may have crossed an
+        # anchor boundary — gather the quorum off the consensus path
+        self._maybe_collect_anchor()
         self._sample_health()
 
     def _note_flush_obs(self, kc, new_events) -> None:
@@ -1904,7 +2206,7 @@ class Node:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                self.logger.warning("consensus loop failed: %s", e)
+                self.logger.warning("consensus loop failed: %s", e, exc_info=True)
 
     async def _commit_loop(self) -> None:
         """Deliver consensus transactions to the app, strictly in batch

@@ -96,6 +96,29 @@ def _build_meta(engine: TpuHashgraph) -> dict:
             dict(engine.pending_membership)
             if engine.pending_membership else None
         ),
+        # pipelined membership: transitions queued behind the pending
+        # boundary (FIFO; each re-checked like the pending entry)
+        "membership_queue": [
+            dict(e) for e in getattr(engine, "membership_queue", ())
+        ],
+        # bounded membership_log: the truncation base + the gossip
+        # addresses of members whose join entries were truncated
+        "membership_base_epoch": getattr(
+            engine, "membership_base_epoch", 0
+        ),
+        "membership_addrs": sorted(
+            getattr(engine, "membership_addrs", {}).items()
+        ),
+        # adversarial-ts defense: effective-timestamp overrides — the
+        # (window-local slot, clamped ns) pairs where the clamp fired.
+        # Honest fleets serialize an empty list; future inserts' clamp
+        # windows derive from these, so they are first-class state.
+        "ts_clamped": [
+            [i, int(dag.eff_ts[dag.slot_base + i])]
+            for i in range(dag.n_events - dag.slot_base)
+            if dag.eff_ts[dag.slot_base + i]
+            != dag.events[dag.slot_base + i].body.timestamp
+        ],
         "slot_base": dag.slot_base,
         "events": [_pack_event(ev) for ev in dag.events],  # window, slot order
         "levels": list(dag.levels),
@@ -408,6 +431,36 @@ def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
     CommitDigest.check_meta(meta.get("digest"))
 
 
+def _check_pending_entry(pend, label: str) -> None:
+    """Structural + signature bounds for one serialized in-flight
+    membership transition (the pending entry or a queued one)."""
+    if pend is None:
+        return
+    if not isinstance(pend, dict):
+        raise ValueError(f"snapshot {label} malformed")
+    for key, typ in (("kind", str), ("pub", str), ("addr", str),
+                     ("boundary", int), ("position", int)):
+        if not isinstance(pend.get(key), typ):
+            raise ValueError(
+                f"snapshot {label} field {key} malformed"
+            )
+    tx = pend.get("tx")
+    if not isinstance(tx, (bytes, bytearray)) or len(tx) > 4096:
+        raise ValueError(f"snapshot {label} tx malformed")
+    from ..membership.transition import parse_membership_tx
+
+    spec = parse_membership_tx(bytes(tx))
+    if spec is None or (spec.kind, spec.pub_hex, spec.net_addr) != (
+            pend["kind"], pend["pub"], pend["addr"]):
+        raise ValueError(
+            f"snapshot {label} contradicts its signed tx"
+        )
+    if not spec.verify():
+        raise ValueError(
+            f"snapshot {label} tx has a bad subject signature"
+        )
+
+
 def _check_host_meta(meta: dict) -> None:
     """Hostile-snapshot bounds for the ISSUE-8 host fields on the
     fused/wide path (the byzantine twin lives in _check_fork_meta):
@@ -477,37 +530,48 @@ def _check_host_meta(meta: dict) -> None:
             f"snapshot membership log ({len(log)} entries) longer than "
             f"its epoch {epoch}"
         )
-    pend = meta.get("pending_membership")
-    if pend is not None:
-        if not isinstance(pend, dict):
-            raise ValueError("snapshot pending_membership malformed")
-        for key, typ in (("kind", str), ("pub", str), ("addr", str),
-                         ("boundary", int), ("position", int)):
-            if not isinstance(pend.get(key), typ):
-                raise ValueError(
-                    f"snapshot pending_membership field {key} malformed"
-                )
-        tx = pend.get("tx")
-        if not isinstance(tx, (bytes, bytearray)) or len(tx) > 4096:
-            raise ValueError("snapshot pending_membership tx malformed")
-        # the pending transition is CONSUMED by apply_epoch_transition
-        # at the boundary — without re-verifying the embedded signed tx
-        # here, a byzantine responder could smuggle a validator join
-        # nobody signed (or an unauthorized leave) through an otherwise
-        # genuine, quorum-attested snapshot
-        from ..membership.transition import parse_membership_tx
+    # the pending transition (and everything queued behind it) is
+    # CONSUMED by apply_epoch_transition at its boundary — without
+    # re-verifying the embedded signed txs here, a byzantine responder
+    # could smuggle a validator join nobody signed (or an unauthorized
+    # leave) through an otherwise genuine, quorum-attested snapshot
+    _check_pending_entry(meta.get("pending_membership"),
+                         "pending_membership")
+    queue = meta.get("membership_queue", [])
+    from ..consensus.engine import MEMBERSHIP_QUEUE_MAX
 
-        spec = parse_membership_tx(bytes(tx))
-        if spec is None or (spec.kind, spec.pub_hex, spec.net_addr) != (
-                pend["kind"], pend["pub"], pend["addr"]):
-            raise ValueError(
-                "snapshot pending_membership contradicts its signed tx"
-            )
-        if not spec.verify():
-            raise ValueError(
-                "snapshot pending_membership tx has a bad subject "
-                "signature"
-            )
+    if not isinstance(queue, list) or len(queue) > MEMBERSHIP_QUEUE_MAX:
+        raise ValueError("snapshot membership_queue out of bounds")
+    for q in queue:
+        if q is None:
+            raise ValueError("snapshot membership_queue entry malformed")
+        _check_pending_entry(q, "membership_queue entry")
+    base = meta.get("membership_base_epoch", 0)
+    if not isinstance(base, int) or not (0 <= base <= epoch):
+        raise ValueError(
+            f"snapshot membership_base_epoch={base!r} out of bounds"
+        )
+    addrs = meta.get("membership_addrs", [])
+    if not isinstance(addrs, (list, tuple)) or len(addrs) > n:
+        raise ValueError("snapshot membership_addrs out of bounds")
+    for item in addrs:
+        pub, addr = item
+        if not isinstance(pub, str) or not (8 <= len(pub) <= 256) \
+                or not isinstance(addr, str) or len(addr) > 256:
+            raise ValueError("snapshot membership_addrs entry malformed")
+    clamped = meta.get("ts_clamped", [])
+    n_events = len(meta["events"])
+    if not isinstance(clamped, (list, tuple)) or len(clamped) > n_events:
+        raise ValueError("snapshot ts_clamped out of bounds")
+    for item in clamped:
+        i, eff = item
+        # int64-exact bound: 2**63 itself does not fit the np.int64
+        # batch arrays and would OverflowError the adopting node's
+        # next flush — exactly the hostile DoS this check exists for
+        if not isinstance(i, int) or not (0 <= i < n_events) \
+                or not isinstance(eff, int) \
+                or not (-(1 << 63) <= eff < (1 << 63)):
+            raise ValueError("snapshot ts_clamped entry malformed")
     # retired columns (cfg field 9) must name real, unique columns
     cfg_fields = meta.get("cfg", [])
     retired = cfg_fields[8] if len(cfg_fields) > 8 else ()
@@ -943,6 +1007,13 @@ def _restore_host(engine, meta: dict) -> None:
     dag.wire_meta = OffsetList(
         [tuple(m) for m in meta["wire_meta"]], base
     )
+    # effective timestamps (adversarial-ts defense): claimed values
+    # with the serialized clamp overrides applied — future inserts'
+    # clamp windows derive from these, so they must round-trip exactly
+    eff = [ev.body.timestamp for ev in events]
+    for i, v in meta.get("ts_clamped", []):
+        eff[int(i)] = int(v)
+    dag.eff_ts = OffsetList(eff, base)
     dag.chains = [
         OffsetList(items, start) for start, items in meta["chains"]
     ]
@@ -974,6 +1045,19 @@ def _restore_host(engine, meta: dict) -> None:
     engine.pending_membership = (
         {**pend, "tx": bytes(pend["tx"])} if pend else None
     )
+    # pipelined membership + bounded-log state (pre-existing
+    # checkpoints restore with the empty defaults)
+    engine.membership_queue = [
+        {**q, "tx": bytes(q["tx"])}
+        for q in meta.get("membership_queue", [])
+    ]
+    engine.membership_base_epoch = int(
+        meta.get("membership_base_epoch", 0)
+    )
+    engine.membership_addrs = {
+        str(pub): str(addr)
+        for pub, addr in meta.get("membership_addrs", [])
+    }
 
 
 def _restore_wide_engine(

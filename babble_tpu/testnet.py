@@ -51,15 +51,22 @@ class PortLayout:
 
 
 def build_conf(base_dir: str, n: int, ports: Optional[PortLayout] = None,
-               overwrite: bool = False) -> List[str]:
+               overwrite: bool = False, joiners: int = 0) -> List[str]:
     """Create node datadirs with keys + the shared peers.json
-    (reference docker/scripts/build-conf.sh:1-45)."""
+    (reference docker/scripts/build-conf.sh:1-45).
+
+    ``joiners`` (membership plane) creates ``joiners`` extra datadirs
+    past the founding set: each gets its own key, a peers.json naming
+    the founders PLUS itself (its gossip address book), and a
+    ``bootstrap_peers.json`` naming the founders only (its epoch-0
+    validator set — the node runs as an observer until its signed join
+    tx commits; cli --bootstrap_peers)."""
     ports = ports or PortLayout()
     if overwrite and os.path.isdir(base_dir):
         shutil.rmtree(base_dir)
     keys = []
     datadirs = []
-    for i in range(n):
+    for i in range(n + joiners):
         d = os.path.join(base_dir, f"node{i}")
         os.makedirs(d, exist_ok=True)
         pem = PemKeyFile(d)
@@ -67,12 +74,22 @@ def build_conf(base_dir: str, n: int, ports: Optional[PortLayout] = None,
         if not pem.exists():
             pem.write(keys[-1])
         datadirs.append(d)
-    peers = [
+    founders = [
         Peer(net_addr=ports.of(i)["gossip"], pub_key_hex=keys[i].pub_hex)
         for i in range(n)
     ]
-    for d in datadirs:
-        JSONPeers(d).set_peers(peers)
+    for i, d in enumerate(datadirs):
+        if i < n:
+            JSONPeers(d).set_peers(founders)
+        else:
+            JSONPeers(d).set_peers(founders + [
+                Peer(net_addr=ports.of(i)["gossip"],
+                     pub_key_hex=keys[i].pub_hex)
+            ])
+            with open(os.path.join(d, "bootstrap_peers.json"), "w") as f:
+                json.dump([{"NetAddr": p.net_addr,
+                            "PubKeyHex": p.pub_key_hex}
+                           for p in founders], f, indent=1)
     return datadirs
 
 
@@ -106,6 +123,12 @@ class TestnetRunner:
     #: the fleet with --no_pipeline/--no_eager_gossip — the lockstep
     #: reference shape, the ingress bench's A/B baseline
     pipeline: bool = True
+    #: membership plane: datadirs prepared for nodes past the founding
+    #: set (indices n..n+joiners-1).  They are NOT booted by start() —
+    #: the driver calls spawn_joiner(i) at its scheduled tick; the
+    #: joiner runs as an observer (--bootstrap_peers) until its signed
+    #: join tx commits at an epoch boundary.
+    joiners: int = 0
     #: AOT prewarm at node boot (ops/aot.py): every node replays the
     #: shared jax_cache dir's shape manifest through lower().compile()
     #: before its first flush, so a fleet RESTART reaches consensus in
@@ -147,6 +170,11 @@ class TestnetRunner:
             "--cache_size", str(self.cache_size),
             "--log_level", "warning",
         ] + self.extra_node_args
+        if i >= self.n:
+            # joiner: founders-only epoch-0 validator set; observer
+            # until its join tx's boundary admits it
+            args += ["--bootstrap_peers",
+                     os.path.join(d, "bootstrap_peers.json")]
         if self.byzantine:
             args.append("--byzantine")
         if self.checkpoints:
@@ -176,8 +204,33 @@ class TestnetRunner:
         self.node_procs[i] = proc
         return proc
 
+    def spawn_joiner(self, i: int) -> None:
+        """Boot joiner ``i`` (an index past the founding set) plus its
+        dummy app when the fleet runs clients — the membership plane's
+        live-churn driver calls this at the join op's scheduled tick."""
+        if not (self.n <= i < self.n + self.joiners):
+            raise ValueError(f"joiner index {i} outside "
+                             f"[{self.n}, {self.n + self.joiners})")
+        if i in self.node_procs:
+            return
+        p = self.ports.of(i)
+        d = os.path.join(self.base_dir, f"node{i}")
+        self.procs.append(self._spawn_node(i))
+        if self.with_clients:
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-m", "babble_tpu.cli", "dummy",
+                 "--node_addr", p["submit"],
+                 "--listen", p["commit"],
+                 "--log", os.path.join(d, "messages.txt"),
+                 "--quiet"],
+                env=self._env(), stdin=subprocess.DEVNULL,
+                stdout=open(os.path.join(d, "dummy.log"), "w"),
+                stderr=subprocess.STDOUT,
+            ))
+
     def start(self) -> None:
-        build_conf(self.base_dir, self.n, self.ports)
+        build_conf(self.base_dir, self.n, self.ports,
+                   joiners=self.joiners)
         env = self._env()
         if "--jax_cache" not in self.extra_node_args:
             # one SHARED jit cache for the whole fleet: N same-shape
@@ -357,8 +410,10 @@ async def bombard(
             try:
                 await clients[i].call("Babble.SubmitTx", b64e(payload))
                 sent += 1
-            except (OSError, RuntimeError):
-                # node not up (yet) — move on to the next one
+            except (OSError, RuntimeError, asyncio.TimeoutError):
+                # node not up (yet), or mid-compile and slow to answer
+                # — move on to the next one (an escaping TimeoutError
+                # used to kill the whole bombard thread)
                 await asyncio.sleep(0.05)
                 continue
             await asyncio.sleep(1.0 / rate)

@@ -334,6 +334,34 @@ def test_canned_scenario_seed_sweep(name, seed):
 
 
 @pytest.mark.slow
+def test_live_churn_join_under_load(tmp_path):
+    """Live-mode churn (ROADMAP 5a, previously untested): the
+    join-under-load shape through the subprocess fleet — a real joiner
+    process boots mid-run as an observer, its subject-signed join tx is
+    submitted through a founder's SubmitTx front door, a founder leaves
+    later, and every reachable node ends at epoch 2 with consensus
+    advanced."""
+    from babble_tpu.chaos import Scenario, load_scenario, run_live
+
+    sc = load_scenario("join-under-load")
+    # stretch the timeline for a CPU test container: node boot (JAX
+    # import + first compiles) must fit inside the early ticks, and
+    # epoch boundaries need committed rounds on both sides
+    sc = Scenario.from_dict({**sc.to_dict(), "tick_seconds": 0.3})
+    report = run_live(sc, str(tmp_path / "live"), rate=10.0,
+                      log=lambda *_: None)
+    assert report["advanced"], report.get("stats")
+    epochs = report.get("epochs", {})
+    reached = [v for v in epochs.values() if isinstance(v, int)]
+    assert reached, epochs
+    assert all(v == 2 for v in reached), epochs
+    # the joiner process itself came up and committed
+    joiner_row = report["stats"][sc.nodes]
+    assert "error" not in joiner_row, joiner_row
+    assert int(joiner_row["consensus_events"]) > 0, joiner_row
+
+
+@pytest.mark.slow
 def test_minority_partition_cli_reproducible_end_to_end(capsys):
     """The acceptance criterion verbatim: `python -m babble_tpu.cli
     chaos run` on the minority-partition scenario with a fixed seed is
