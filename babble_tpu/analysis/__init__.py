@@ -22,6 +22,11 @@ Rule families (see ISSUE 1/4 / the rules' module docstrings):
   (``recompile-hazard``), partition-rule and SPMD-sentinel coverage
   (``partition-spec-coverage``), flush-traffic-model coverage
   (``bytes-model-coverage``)
+- :mod:`.hostile` — trust-boundary taint: peer-decoded values must
+  pass a bounds guard before any size-bearing sink
+  (``unbounded-hostile-input``)
+- :mod:`.parity` — declarative insert-path invariant registry diffed
+  against every engine surface's call closure (``engine-parity``)
 
 The flow-aware rules stand on :mod:`.graph` (module symbol table +
 project call graph), built once per run by the engine and attached to
@@ -63,7 +68,9 @@ from .device import (
     RecompileHazardRule,
 )
 from .guards import HeldGuardEscapeRule
+from .hostile import UnboundedHostileInputRule
 from .invariants import DrainBeforeValidateRule, FalsyOrFallbackRule
+from .parity import EngineParityRule
 from .races import AwaitStateRaceRule
 from .randomness import ChaosUnseededRandomRule
 from .tracer import (
@@ -94,6 +101,8 @@ ALL_RULES = [
     RecompileHazardRule(),
     PartitionSpecCoverageRule(),
     BytesModelCoverageRule(),
+    UnboundedHostileInputRule(),
+    EngineParityRule(),
 ]
 
 RULE_NAMES = ({r.name for r in ALL_RULES}
@@ -121,6 +130,7 @@ __all__ = [
     "ConsensusNondeterminismRule",
     "DonateUseAfterFreeRule",
     "DrainBeforeValidateRule",
+    "EngineParityRule",
     "FalsyOrFallbackRule",
     "HeldGuardEscapeRule",
     "JitHostSyncRule",
@@ -129,6 +139,7 @@ __all__ = [
     "PartitionSpecCoverageRule",
     "RecompileHazardRule",
     "StaleQuorumMathRule",
+    "UnboundedHostileInputRule",
     "UnverifiedSnapshotAdoptRule",
     "WalBeforeGossipRule",
 ]

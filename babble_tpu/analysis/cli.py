@@ -20,6 +20,17 @@ Exit status is the contract CI keys off: 0 = clean, 1 = findings,
 (mtime, size) plus the rule set — an untouched tree replays findings
 without parsing anything (see cache.py for why per-file caching would
 be unsound under cross-module analysis).
+
+``--baseline FILE`` is the suppression ratchet: the committed file
+(``.babble-lint-baseline.json``) records how many waived findings each
+``path::rule`` pair is allowed.  Pre-existing waivers pass; a NEW
+suppression — any pair exceeding its baseline count — fails the run
+with a diff on stderr, exactly like a new live finding does.  Counts
+are keyed per (path, rule), not per line, so routine edits that shift
+line numbers never invalidate the baseline; ``--write-baseline``
+regenerates the file when a waiver is deliberately added or retired
+(shrinking counts only loosens the ratchet when committed, which is
+what code review is for).
 """
 
 from __future__ import annotations
@@ -117,6 +128,17 @@ def main(argv: Optional[List[str]] = None) -> int:
              "untouched tree skips re-parsing entirely",
     )
     parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="suppression ratchet: fail when any path::rule pair "
+             "carries more waived findings than the committed "
+             "baseline allows",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current waiver inventory to --baseline FILE "
+             "and exit (requires --baseline)",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
     )
@@ -130,6 +152,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # each claims stdout whole — silently picking one would feed a
         # SARIF upload step JSONL (or vice versa) with exit 0
         print("--json and --sarif are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    if args.write_baseline and not args.baseline:
+        print("--write-baseline requires --baseline FILE",
               file=sys.stderr)
         return 2
 
@@ -158,7 +184,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from . import RULE_NAMES
 
-    include_suppressed = bool(args.json or args.sarif)
+    include_suppressed = bool(args.json or args.sarif or args.baseline)
     if args.cache:
         findings, _hit = run_paths_cached(
             args.paths, rules, args.cache, known_rules=RULE_NAMES,
@@ -169,6 +195,48 @@ def main(argv: Optional[List[str]] = None) -> int:
                              include_suppressed=include_suppressed)
 
     live = [f for f in findings if not f.suppressed]
+
+    ratchet_broken = []
+    if args.baseline:
+        counts: dict = {}
+        for f in findings:
+            if f.suppressed:
+                key = f"{f.path.replace(os.sep, '/')}::{f.rule}"
+                counts[key] = counts.get(key, 0) + 1
+        if args.write_baseline:
+            doc = {"version": 1, "waived": counts}
+            with open(args.baseline, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+            print(f"baseline written: {args.baseline} "
+                  f"({sum(counts.values())} waived finding(s) across "
+                  f"{len(counts)} path::rule pair(s))", file=sys.stderr)
+        else:
+            # a missing or unreadable baseline must fail loudly: exit 0
+            # with the ratchet silently off would never fail again
+            try:
+                with open(args.baseline, encoding="utf-8") as fh:
+                    allowed = json.load(fh).get("waived", {})
+            except (OSError, ValueError) as exc:
+                print(f"cannot read baseline {args.baseline}: {exc}",
+                      file=sys.stderr)
+                return 2
+            if not isinstance(allowed, dict):
+                print(f"malformed baseline {args.baseline}: 'waived' "
+                      "must be an object", file=sys.stderr)
+                return 2
+            for key in sorted(counts):
+                if counts[key] > allowed.get(key, 0):
+                    ratchet_broken.append(
+                        f"NEW suppression: {key} — {counts[key]} "
+                        f"waived, baseline allows {allowed.get(key, 0)}"
+                    )
+            retired = sorted(k for k in allowed if k not in counts)
+            if retired:
+                print("note: baseline entries no longer needed "
+                      "(re-run with --write-baseline to tighten): "
+                      + ", ".join(retired), file=sys.stderr)
+
     if args.json:
         for f in findings:
             print(json.dumps(f.to_dict(), sort_keys=True))
@@ -182,4 +250,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f.format())
         if live:
             print(f"\n{len(live)} finding(s)", file=sys.stderr)
-    return 1 if live else 0
+    if ratchet_broken:
+        print("suppression ratchet failed against "
+              f"{args.baseline}:", file=sys.stderr)
+        for line in ratchet_broken:
+            print(f"  {line}", file=sys.stderr)
+    return 1 if live or ratchet_broken else 0

@@ -57,6 +57,11 @@ class ForkOracle:
     lcr: int = -1
     # fork pairs per creator, filled lazily as events arrive
     _fork_pairs: Dict[int, List[Tuple[str, str]]] = field(default_factory=dict)
+    #: clamp-enforced effective timestamps (adversarial-ts defense) —
+    #: the values the consensus-timestamp median consumes, mirroring
+    #: ops/forks.py ForkDag.eff_ts so the oracle stays the differential
+    #: ground truth under lying-timestamp attacks
+    _eff_ts: Dict[str, int] = field(default_factory=dict)
 
     @property
     def n(self) -> int:
@@ -108,6 +113,16 @@ class ForkOracle:
             if z not in self.self_anc[x] and x not in self.self_anc[z]:
                 pairs.append((x, z))
         prior.append(x)
+
+        # per-creator eff-ts clamp, identical to ForkDag.insert — refs
+        # are the parents' EFFECTIVE values, absent parents contribute
+        # nothing (pseudo-roots keep their claim)
+        from ..core.dag import clamp_eff_ts
+
+        refs = [self._eff_ts[p] for p in (sp, op) if p in self._eff_ts]
+        self._eff_ts[x] = clamp_eff_ts(
+            event.body.timestamp, max(refs) if refs else None
+        )
 
         self.events[x] = event
         self.order.append(x)
@@ -283,11 +298,16 @@ class ForkOracle:
                 s = [w for w in fam if self.see(w, x)]
                 if len(s) > len(fam) // 2:
                     self.rr[x] = i
+                    # effective (clamped) timestamps, like ForkDag's
+                    # build_batch ts feed — never the signed claims
                     ts = sorted(
-                        self.events[
+                        self._eff_ts.get(
+                            h, self.events[h].body.timestamp
+                        )
+                        for h in (
                             self.oldest_self_ancestor_to_see(w, x)
-                        ].body.timestamp
-                        for w in s
+                            for w in s
+                        )
                     )
                     self.cts[x] = ts[len(ts) // 2]
                     ev = self.events[x]

@@ -56,6 +56,17 @@ class InsertError(ValueError):
 TS_CLAMP_WINDOW_NS = 600_000_000_000  # 10 min of ns
 
 
+def clamp_eff_ts(claimed: int, parent_ref: Optional[int]) -> int:
+    """The single clamp seam every ingestion surface must route through
+    (babble-lint engine-parity: timestamp-clamp): effective timestamp of
+    an event claiming ``claimed`` whose known parents' max effective
+    timestamp is ``parent_ref`` (``None`` for roots/pseudo-roots, whose
+    subtree was clamped while it was live)."""
+    if parent_ref is None:
+        return claimed
+    return min(max(claimed, parent_ref + 1), parent_ref + TS_CLAMP_WINDOW_NS)
+
+
 @dataclass
 class HostDag:
     participants: Dict[str, int]              # pub hex -> id
@@ -216,11 +227,7 @@ class HostDag:
             op_eff = self.eff_ts[ops]
             parent_ref = op_eff if parent_ref is None \
                 else max(parent_ref, op_eff)
-        if parent_ref is None:
-            eff = claimed
-        else:
-            eff = min(max(claimed, parent_ref + 1),
-                      parent_ref + TS_CLAMP_WINDOW_NS)
+        eff = clamp_eff_ts(claimed, parent_ref)
         self.events.append(event)
         self.slot_of[hex_id] = slot
         self.levels.append(level)

@@ -31,6 +31,16 @@ def _int_to_b32(v: int) -> bytes:
     return v.to_bytes(_SCALAR_BYTES, "big")
 
 
+def _check_wire_bytes(v) -> bytes:
+    """Type gate for peer-decoded byte fields: msgpack happily decodes an
+    int where bytes were expected, and ``bytes(2**40)`` *allocates* that
+    many zeros — an attacker-priced OOM.  Copying a materialized
+    bytes-like is bounded by the frame that carried it."""
+    if not isinstance(v, (bytes, bytearray, memoryview)):
+        raise TypeError(f"wire field must be bytes-like, got {type(v).__name__}")
+    return bytes(v)
+
+
 def middle_bit(hash_bytes: bytes) -> bool:
     """Coin-flip bit for fame coin rounds: middle byte of an event's identity
     hash non-zero (reference hashgraph.go:781-790 middleBit).  Single source
@@ -203,7 +213,7 @@ class WireEvent:
     def unpack(cls, obj: list) -> "WireEvent":
         (txs, spi, opc, opi, cid, ts, idx, r, s) = obj
         return cls(
-            transactions=[bytes(t) for t in txs],
+            transactions=[_check_wire_bytes(t) for t in txs],
             self_parent_index=spi,
             other_parent_creator_id=opc,
             other_parent_index=opi,
@@ -250,8 +260,9 @@ class FullWireEvent:
     def unpack(cls, obj: list) -> "FullWireEvent":
         (txs, sp, op, creator, ts, idx, r, s) = obj
         return cls(
-            transactions=[bytes(t) for t in txs],
-            self_parent=sp, other_parent=op, creator=bytes(creator),
+            transactions=[_check_wire_bytes(t) for t in txs],
+            self_parent=sp, other_parent=op,
+            creator=_check_wire_bytes(creator),
             timestamp=ts, index=idx,
             r=int.from_bytes(r, "big"), s=int.from_bytes(s, "big"),
         )

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dag import clamp_eff_ts
 from ..core.event import Event
 from .state import I32, I64, INT32_MAX, sanitize, set_sentinel
 from ..membership.quorum import supermajority
@@ -170,6 +171,13 @@ class ForkDag:
     wseed: List[int] = field(default_factory=list)
     r_off: int = 0
     evicted: int = 0
+    # effective (clamp-enforced) timestamp per slot — same adversarial-ts
+    # defense as HostDag.eff_ts (core/dag.py TS_CLAMP_WINDOW_NS), derived
+    # at insert from the parents' effective values.  The median kernels
+    # consume these, never the signed claims; a fork's branches clamp
+    # against their own ancestry, so equivocating AND lying about time
+    # buys a byzantine creator nothing extra.
+    eff_ts: List[int] = field(default_factory=list)
     # absolute chain extent per branch (max index + 1) — survives
     # eviction, unlike window lengths
     br_extent: List[int] = field(init=False)
@@ -251,6 +259,16 @@ class ForkDag:
         self.br_extent[col] = max(self.br_extent[col], event.index + 1)
         self.rseed.append(-1)
         self.wseed.append(-1)
+        # per-creator eff-ts clamp (engine-parity: timestamp-clamp) —
+        # evicted parents contribute nothing, same as HostDag pseudo-roots
+        parent_ref = None
+        if sps >= 0:
+            parent_ref = self.eff_ts[sps]
+        if ops >= 0:
+            op_eff = self.eff_ts[ops]
+            parent_ref = op_eff if parent_ref is None \
+                else max(parent_ref, op_eff)
+        self.eff_ts.append(clamp_eff_ts(event.body.timestamp, parent_ref))
         lvl = 0
         if sps >= 0 or ops >= 0:
             lvl = 1 + max(
@@ -280,6 +298,7 @@ class ForkDag:
         self.levels = self.levels[k:]
         self.rseed = self.rseed[k:]
         self.wseed = self.wseed[k:]
+        self.eff_ts = self.eff_ts[k:]
 
         def remap(v: int) -> int:
             return v - k if v >= k else -1
@@ -386,7 +405,9 @@ class ForkDag:
             ebr[s] = self.ebr[s]
             eseq[s] = ev.index
             ecr[s] = self.participants[ev.creator]
-            ts[s] = ev.body.timestamp
+            # effective (clamped) timestamps, never the signed claims —
+            # the adversarial-ts defense's single seam, like dag.eff_ts
+            ts[s] = self.eff_ts[s]
             mbit[s] = ev.middle_bit()
 
         lev = np.asarray(self.levels, np.int64)

@@ -294,6 +294,14 @@ def _build_fork_meta(engine) -> dict:
         "wseed": list(dag.wseed),
         "r_off": dag.r_off,
         "evicted": dag.evicted,
+        # adversarial-ts defense: effective-timestamp overrides, same
+        # sparse (window slot, clamped ns) encoding as the host meta —
+        # honest fleets serialize an empty list
+        "ts_clamped": [
+            [i, int(dag.eff_ts[i])]
+            for i in range(len(dag.events))
+            if dag.eff_ts[i] != dag.events[i].body.timestamp
+        ],
         "consensus": list(engine.consensus),
         "digest": engine._digest.to_meta(),
         "consensus_transactions": engine.consensus_transactions,
@@ -427,6 +435,18 @@ def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
     for col, s in meta["chain_tip"]:
         if not (0 <= col < b and 0 <= s < ne):
             raise ValueError("snapshot chain tip out of range")
+    # effective-timestamp overrides: same int64-exact bound as the host
+    # meta — 2**63 would OverflowError the adopting node's next
+    # build_batch np.int64 fill, exactly the hostile DoS this gates
+    clamped = meta.get("ts_clamped", [])
+    if not isinstance(clamped, (list, tuple)) or len(clamped) > ne:
+        raise ValueError("snapshot ts_clamped out of bounds")
+    for item in clamped:
+        i, eff = item
+        if not isinstance(i, int) or not (0 <= i < ne) \
+                or not isinstance(eff, int) \
+                or not (-(1 << 63) <= eff < (1 << 63)):
+            raise ValueError("snapshot ts_clamped entry malformed")
     from ..consensus.digest import CommitDigest
     CommitDigest.check_meta(meta.get("digest"))
 
@@ -676,6 +696,12 @@ def _restore_fork_engine(
     dag.wseed = [int(v) for v in meta["wseed"]]
     dag.r_off = int(meta["r_off"])
     dag.evicted = evicted
+    # effective timestamps: the claim unless a clamp override says
+    # otherwise (sparse encoding, _build_fork_meta)
+    eff = [ev.body.timestamp for ev in events]
+    for i, v in meta.get("ts_clamped", []):
+        eff[i] = int(v)
+    dag.eff_ts = eff
     engine.consensus = list(meta["consensus"])
     from ..consensus.digest import CommitDigest
 

@@ -1,0 +1,69 @@
+"""engine-parity fixture: two engine surfaces share one invariant
+registry; `PortedHashgraph` witnesses everything, `DriftedHashgraph`
+ships its ingest path without the timestamp clamp — the exact drift
+the fork engine had on landing.  Exactly one finding, at the drifted
+insert_event."""
+
+
+def clamp_eff_ts(claimed, parent_ref):
+    if parent_ref is None:
+        return claimed
+    return min(max(claimed, parent_ref + 1), parent_ref + 600)
+
+
+def supermajority(n):
+    return n - n // 3
+
+
+def check_host_meta(meta):
+    if len(meta) > 64:
+        raise ValueError("meta too large")
+
+
+class PortedHashgraph:
+    """Witnesses timestamp-clamp + quorum routing on its own closure."""
+
+    def __init__(self, peers):
+        self.peers = peers
+        self.sm = supermajority(len(peers))
+        self.eff = []
+
+    def insert_event(self, ev):
+        ref = self.eff[-1] if self.eff else None
+        self.eff.append(clamp_eff_ts(ev.ts, ref))
+
+
+class DriftedHashgraph:
+    """Quorum routed, clamp forgotten: trusts the signed claim raw."""
+
+    def __init__(self, peers):
+        self.sm = supermajority(len(peers))
+        self.ts = []
+
+    def insert_event(self, ev):  # MARK: engine-parity
+        self.ts.append(ev.ts)
+
+
+class Runtime:
+    """Integration class holding both engines: carries the
+    engine-agnostic gates (retired ingress, WAL append) for both."""
+
+    def __init__(self, peers, wal):
+        self.ported = PortedHashgraph(peers)
+        self.drifted = DriftedHashgraph(peers)
+        self.retired = set()
+        self.wal = wal
+
+    def ingest(self, cid, ev):
+        if cid in self.retired:
+            raise ValueError("retired creator")
+        self.wal.append(ev)
+        self.ported.insert_event(ev)
+        self.drifted.insert_event(ev)
+
+
+def load_snapshot(meta):
+    """Adoption path: bounds-checks the peer meta before constructing,
+    so hostile-meta-check is satisfied for the engine it builds."""
+    check_host_meta(meta)
+    return PortedHashgraph(meta["peers"])

@@ -1,0 +1,47 @@
+"""engine-parity clean twin: one engine surface, every registry
+invariant witnessed — clamp and quorum on the engine's own closure,
+retired gate and WAL append on the integration class, meta bounds on
+the adoption path.  Zero findings."""
+
+
+def clamp_eff_ts(claimed, parent_ref):
+    if parent_ref is None:
+        return claimed
+    return min(max(claimed, parent_ref + 1), parent_ref + 600)
+
+
+def supermajority(n):
+    return n - n // 3
+
+
+def check_snapshot_meta(meta):
+    if len(meta) > 64:
+        raise ValueError("meta too large")
+
+
+class WindowHashgraph:
+    def __init__(self, peers):
+        self.sm = supermajority(len(peers))
+        self.eff = []
+
+    def insert_event(self, ev):
+        ref = self.eff[-1] if self.eff else None
+        self.eff.append(clamp_eff_ts(ev.ts, ref))
+
+
+class Host:
+    def __init__(self, peers, wal):
+        self.hg = WindowHashgraph(peers)
+        self.retired = set()
+        self.wal = wal
+
+    def ingest(self, cid, ev):
+        if cid in self.retired:
+            raise ValueError("retired creator")
+        self.wal.append(ev)
+        self.hg.insert_event(ev)
+
+
+def load_snapshot(meta):
+    check_snapshot_meta(meta)
+    return WindowHashgraph(meta["peers"])

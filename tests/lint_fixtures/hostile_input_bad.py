@@ -1,0 +1,42 @@
+"""unbounded-hostile-input fixture: peer-decoded integers reaching
+size-bearing sinks with no bounds guard — the wire-command shapes that
+produced the byzantine 1.1 TB OOM.  One finding per MARK line; the
+taint survives dict reads, loop targets and a helper-return hop."""
+
+import msgpack
+import numpy as np
+
+
+def handle_window_decl(payload):
+    """A declared window size prices an allocation directly."""
+    obj = msgpack.unpackb(payload, raw=False)
+    n = obj["n_events"]
+    return np.zeros((n, 64), dtype=np.uint8)  # MARK: unbounded-hostile-input
+
+
+def handle_branch_extents(payload):
+    """Per-branch extents: hostile via iteration over a decoded list."""
+    obj = msgpack.unpackb(payload, raw=False)
+    out = []
+    for cap in obj["caps"]:
+        out.extend([0] * cap)  # MARK: unbounded-hostile-input
+    return out
+
+
+def handle_replay(payload):
+    """A replay count prices a loop bound."""
+    count = msgpack.unpackb(payload, raw=False)["count"]
+    acc = 0
+    for i in range(count):  # MARK: unbounded-hostile-input
+        acc += i
+    return acc
+
+
+def _decode_header(payload):
+    return msgpack.unpackb(payload, raw=False)
+
+
+def handle_scratch(payload):
+    """The taint crosses a helper return before pricing a buffer."""
+    hdr = _decode_header(payload)
+    return bytearray(hdr["scratch"])  # MARK: unbounded-hostile-input

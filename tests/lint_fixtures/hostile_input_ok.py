@@ -1,0 +1,42 @@
+"""unbounded-hostile-input clean twin: the same wire shapes, each one
+passing a sanctioning guard before its sink — a check_*-family helper
+call, a min() clamp, a raise-guarded if, and len() of a materialized
+frame.  Zero findings."""
+
+import msgpack
+import numpy as np
+
+E_CAP = 1 << 14
+
+
+def check_window_meta(meta):
+    n = meta["n_events"]
+    if not (0 <= n <= E_CAP):
+        raise ValueError("n_events out of bounds")
+
+
+def handle_window_decl(payload):
+    meta = msgpack.unpackb(payload, raw=False)
+    check_window_meta(meta)
+    return np.zeros((meta["n_events"], 64), dtype=np.uint8)
+
+
+def handle_branch_extents(payload):
+    obj = msgpack.unpackb(payload, raw=False)
+    cap = min(obj["cap"], E_CAP)
+    return [0] * cap
+
+
+def handle_replay(payload):
+    count = msgpack.unpackb(payload, raw=False)["count"]
+    if count > E_CAP:
+        raise ValueError("replay window too large")
+    acc = 0
+    for i in range(count):
+        acc += i
+    return acc
+
+
+def handle_frame(payload):
+    frame = msgpack.unpackb(payload, raw=False)
+    return bytearray(len(frame))

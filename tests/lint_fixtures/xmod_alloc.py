@@ -1,0 +1,13 @@
+"""Other half of the cross-module unbounded-hostile-input pair: sizes
+an allocation from meta decoded in xmod_wire.  Alone the import does
+not resolve and the file is clean; the project-wide pass follows the
+hostile return through the module boundary."""
+
+import numpy as np
+
+import xmod_wire
+
+
+def build_window(payload):
+    meta = xmod_wire.read_sync_meta(payload)
+    return np.zeros((meta["e_cap"], 8))  # MARK: unbounded-hostile-input

@@ -1,0 +1,9 @@
+"""Half of the cross-module unbounded-hostile-input pair: decodes
+peer bytes and returns them.  No sink lives here, so THIS file alone
+is clean — only the project-wide pass sees the flow."""
+
+import msgpack
+
+
+def read_sync_meta(payload):
+    return msgpack.unpackb(payload, raw=False)

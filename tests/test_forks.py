@@ -367,3 +367,85 @@ def test_fork_pipeline_sentinel_rows_stay_sentinel():
     assert int(np.asarray(out.round)[cfg.e_cap]) == -1
     assert not bool(np.asarray(out.witness)[cfg.e_cap])
     assert (np.asarray(out.wslot)[cfg.r_cap] == -1).all()
+
+
+def test_fork_engine_clamps_lying_timestamps():
+    """Regression for the PR-16 parity gap: fork ingestion routes
+    through the same per-creator effective-timestamp clamp as the
+    fused/wide engines (core/dag.py clamp_eff_ts), so a lying-clock
+    creator cannot drag the round-received medians more than one clamp
+    window forward.  The oracle mirrors the clamp (differential stays
+    the ground truth) and the clamped values survive a snapshot
+    round-trip."""
+    import numpy as np
+
+    from babble_tpu.core.event import new_event
+    from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+    n, liar = 4, 3
+    lie_ns = 3_600_000_000_000      # claims one hour in the future
+    rng = np.random.default_rng(11)
+
+    def fake_pub(i):
+        return b"\x04" + i.to_bytes(32, "big") + bytes(32)
+
+    participants = {("0x" + fake_pub(i).hex().upper()): i for i in range(n)}
+    pubs = [fake_pub(i) for i in range(n)]
+    heads, seqs = [None] * n, [0] * n
+    events = []
+    t = [0]
+
+    def mint(recv, send):
+        t[0] += 1
+        ts = 1_700_000_000_000_000_000 + t[0] * 2_000_000
+        if recv == liar and heads[recv] is not None:
+            ts += lie_ns
+        parents = ("", "") if heads[recv] is None else (
+            heads[recv], heads[send])
+        ev = new_event([], parents, pubs[recv], seqs[recv], timestamp=ts)
+        ev.r = int(rng.integers(1, 1 << 62))
+        ev.s = int(rng.integers(1, 1 << 62))
+        events.append(ev)
+        heads[recv] = ev.hex()
+        seqs[recv] += 1
+
+    for i in range(n):
+        mint(i, i)
+    for _ in range(140):
+        recv = int(rng.integers(0, n))
+        send = int(rng.integers(0, n - 1))
+        if send >= recv:
+            send += 1
+        mint(recv, send)
+
+    fo = ForkOracle(participants)
+    fh = ForkHashgraph(participants, k=2)
+    _fill(type("D", (), {"events": events})(), fo, fh)
+    committed_h = fh.run_consensus()
+    committed_o = fo.run_consensus()
+
+    dag = fh.dag
+    clamped = 0
+    for s, ev in enumerate(dag.events):
+        eff, claimed = dag.eff_ts[s], ev.body.timestamp
+        # the oracle's mirror is bit-identical per event
+        assert fo._eff_ts[ev.hex()] == eff, ev.hex()[:10]
+        if participants[ev.creator] == liar and ev.body.index > 0:
+            # a lie is admitted at most one clamp window ahead of the
+            # parents; a persistent liar drifts at W per event, not
+            # instantly (early lies MUST be cut down)
+            if eff < claimed:
+                clamped += 1
+        else:
+            # honest events only ever get raised (parent monotonicity)
+            assert eff >= claimed
+    assert clamped > 0, "generator produced no lying events"
+
+    # the committed order AND the consensus timestamps stay differential
+    assert [(e.hex(), e.consensus_timestamp) for e in committed_h] == \
+        [(e.hex(), e.consensus_timestamp) for e in committed_o]
+    assert committed_h, "no events reached consensus"
+
+    # clamp state survives the fast-forward snapshot seam
+    fh2 = load_snapshot(snapshot_bytes(fh), verify_events=False)
+    assert fh2.dag.eff_ts == dag.eff_ts
