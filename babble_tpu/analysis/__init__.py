@@ -27,6 +27,12 @@ Rule families (see ISSUE 1/4 / the rules' module docstrings):
   (``unbounded-hostile-input``)
 - :mod:`.parity` — declarative insert-path invariant registry diffed
   against every engine surface's call closure (``engine-parity``)
+- :mod:`.serial` — serialization-plane schema lint (ISSUE 19):
+  writer/reader field-inventory diffs (``pack-unpack-parity``),
+  exact-partition coverage of checkpoint meta across bounds guards
+  and restores (``checkpoint-field-coverage``), and the committed
+  ``.babble-format-manifest.json`` keyed to version constants
+  (``format-version-ratchet``, bumped via ``--write-format-manifest``)
 
 The flow-aware rules stand on :mod:`.graph` (module symbol table +
 project call graph), built once per run by the engine and attached to
@@ -79,6 +85,11 @@ from .tracer import (
     JitUnhashableStaticRule,
 )
 from .quorummath import StaleQuorumMathRule
+from .serial import (
+    CheckpointFieldCoverageRule,
+    FormatVersionRatchetRule,
+    PackUnpackParityRule,
+)
 from .snapshotadopt import UnverifiedSnapshotAdoptRule
 from .walgossip import WalBeforeGossipRule
 
@@ -103,6 +114,9 @@ ALL_RULES = [
     BytesModelCoverageRule(),
     UnboundedHostileInputRule(),
     EngineParityRule(),
+    PackUnpackParityRule(),
+    CheckpointFieldCoverageRule(),
+    FormatVersionRatchetRule(),
 ]
 
 RULE_NAMES = ({r.name for r in ALL_RULES}
@@ -125,6 +139,7 @@ __all__ = [
     "AsyncioBlockingCallRule",
     "AwaitStateRaceRule",
     "BytesModelCoverageRule",
+    "CheckpointFieldCoverageRule",
     "CodecOnLoopRule",
     "ChaosUnseededRandomRule",
     "ConsensusNondeterminismRule",
@@ -132,10 +147,12 @@ __all__ = [
     "DrainBeforeValidateRule",
     "EngineParityRule",
     "FalsyOrFallbackRule",
+    "FormatVersionRatchetRule",
     "HeldGuardEscapeRule",
     "JitHostSyncRule",
     "JitTracedBranchRule",
     "JitUnhashableStaticRule",
+    "PackUnpackParityRule",
     "PartitionSpecCoverageRule",
     "RecompileHazardRule",
     "StaleQuorumMathRule",

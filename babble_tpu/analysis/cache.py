@@ -24,13 +24,19 @@ import tempfile
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .engine import ANALYSIS_VERSION, Finding, Rule, iter_python_files, run_paths
+from .serial import manifest_candidate_paths
 
 
 def _stat_vector(paths: Iterable[str]) -> Dict[str, Tuple[int, int]]:
-    """path -> (mtime_ns, size) for every file the run would lint.
+    """path -> (mtime_ns, size) for every file the run would lint,
+    PLUS every path where a ``.babble-format-manifest.json`` could
+    shadow one of them — the format-version-ratchet findings depend on
+    the manifest's content, so creating, editing or shadowing a
+    manifest must miss the cache exactly like a source edit.
     A vanished file maps to (-1, -1): still a key change, not a crash."""
+    paths = list(paths)
     out: Dict[str, Tuple[int, int]] = {}
-    for p in paths:
+    for p in list(paths) + manifest_candidate_paths(paths):
         try:
             st = os.stat(p)
             out[p] = (st.st_mtime_ns, st.st_size)

@@ -21,6 +21,17 @@ Exit status is the contract CI keys off: 0 = clean, 1 = findings,
 without parsing anything (see cache.py for why per-file caching would
 be unsound under cross-module analysis).
 
+``--changed`` scopes REPORTING to files touched per git (worktree +
+index vs HEAD, plus untracked) while the analysis itself still runs
+over the full project graph — cross-module rules need every file to
+resolve, but the dev loop only wants findings for what it touched.
+
+``--write-format-manifest`` records the tree's serialized-surface
+field inventory into ``.babble-format-manifest.json`` — the sanctioned
+bump path for the ``format-version-ratchet`` rule.  It refuses (exit
+2) to record a changed inventory whose paired version constant did not
+move: bump the constant first, then re-run.
+
 ``--baseline FILE`` is the suppression ratchet: the committed file
 (``.babble-lint-baseline.json``) records how many waived findings each
 ``path::rule`` pair is allowed.  Pre-existing waivers pass; a NEW
@@ -97,6 +108,34 @@ def sarif_document(findings: List[Finding],
     }
 
 
+def _git_changed_files() -> Optional[set]:
+    """Absolute paths of files changed vs HEAD (worktree + index) plus
+    untracked files, or None when git is unavailable — the dev-loop
+    scope for ``--changed``.  The lint itself still runs whole-graph;
+    only the report is filtered, so a cross-module finding in an
+    untouched file stays visible on a full run."""
+    import subprocess
+
+    out: set = set()
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        for cmd in (
+            ["git", "diff", "--name-only", "HEAD"],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True, cwd=top)
+            for line in res.stdout.splitlines():
+                if line.strip():
+                    out.add(os.path.abspath(os.path.join(top, line.strip())))
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m babble_tpu.analysis",
@@ -137,6 +176,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--write-baseline", action="store_true",
         help="write the current waiver inventory to --baseline FILE "
              "and exit (requires --baseline)",
+    )
+    parser.add_argument(
+        "--write-format-manifest", action="store_true",
+        help="record the tree's serialized-surface field inventory "
+             "into the nearest .babble-format-manifest.json (the "
+             "sanctioned format-version-ratchet bump path); refuses "
+             "when an inventory changed under an unbumped version "
+             "constant",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="report only findings in files changed per git (vs HEAD, "
+             "plus untracked); the analysis still runs over the full "
+             "project graph",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
@@ -182,6 +235,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"no such file or directory: {missing}", file=sys.stderr)
         return 2
 
+    if args.write_format_manifest:
+        from .serial import (
+            MANIFEST_NAME, compute_surfaces, find_manifest, write_manifest,
+        )
+        surfaces = compute_surfaces(args.paths)
+        target = find_manifest(os.path.abspath(args.paths[0]))
+        if target is None:
+            target = os.path.join(os.getcwd(), MANIFEST_NAME)
+        refusals = write_manifest(target, surfaces)
+        if refusals:
+            print("refusing to record a changed inventory under an "
+                  "unbumped version constant:", file=sys.stderr)
+            for line in refusals:
+                print(f"  {line}", file=sys.stderr)
+            return 2
+        print(f"format manifest written: {target} "
+              f"({len(surfaces)} surface(s))", file=sys.stderr)
+        return 0
+
     from . import RULE_NAMES
 
     include_suppressed = bool(args.json or args.sarif or args.baseline)
@@ -193,6 +265,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         findings = run_paths(args.paths, rules, known_rules=RULE_NAMES,
                              include_suppressed=include_suppressed)
+
+    if args.changed:
+        changed = _git_changed_files()
+        if changed is None:
+            print("--changed requires a git checkout (git diff failed)",
+                  file=sys.stderr)
+            return 2
+        findings = [f for f in findings
+                    if os.path.abspath(f.path) in changed]
 
     live = [f for f in findings if not f.suppressed]
 

@@ -50,7 +50,7 @@ from .graph import ProjectContext
 
 #: cache-key component: bump when rule semantics change so a stale
 #: result cache (cache.py) can never mask a new finding
-ANALYSIS_VERSION = "4"
+ANALYSIS_VERSION = "5"
 
 
 @dataclass(frozen=True)

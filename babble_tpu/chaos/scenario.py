@@ -499,12 +499,17 @@ class ScenarioRunner:
                             # first (that is when fsync lies surface),
                             # then checkpoint -> WAL replay -> probe
                             if sc.plan.disk is not None:
-                                apply_disk_faults(
-                                    injector, sc.plan.disk, node_idx,
-                                    ckpt_dir(node_idx),
-                                    os.path.join(durable_root,
-                                                 f"node{node_idx}", "wal"),
-                                )
+                                # off-loop: the structure-relative
+                                # draws decode checkpoint meta
+                                def rot(idx=node_idx):
+                                    apply_disk_faults(
+                                        injector, sc.plan.disk, idx,
+                                        ckpt_dir(idx),
+                                        os.path.join(durable_root,
+                                                     f"node{idx}", "wal"),
+                                    )
+                                await asyncio.get_running_loop() \
+                                    .run_in_executor(None, rot)
                             from ..store import load_checkpoint_tolerant
 
                             engine, _err = load_checkpoint_tolerant(

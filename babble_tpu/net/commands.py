@@ -150,9 +150,14 @@ class FastForwardResponse:
     (store/proof.py).  A proof-less response (``digest == ""``) is what
     pre-proof peers send; joiners with verification on reject it.
     Compat is one-directional by design: upgraded joiners still parse
-    pre-proof 2-tuple responses, but pre-proof joiners cannot parse the
-    7-field form — roll out responders last (or the fleet atomically),
-    or a not-yet-upgraded laggard cannot catch up."""
+    the pre-proof 2-tuple and pre-epoch 7-field forms (the guarded
+    tail reads below — `pack-unpack-parity` understands the length
+    gates), but older joiners cannot parse the current 8-field form —
+    roll out responders last (or the fleet atomically), or a
+    not-yet-upgraded laggard cannot catch up.  Field ORDER is part of
+    the contract (msgpack arrays are positional): appending is the
+    only compatible evolution, and the `format-version-ratchet` lint
+    family pins the recorded order in `.babble-format-manifest.json`."""
 
     from_addr: str
     snapshot: bytes

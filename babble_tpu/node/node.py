@@ -252,8 +252,20 @@ class Node:
         #: rolling attestation checkpoints (ROADMAP item 5): bounded
         #: ring of quorum-co-signed CommitDigest anchors, newest last.
         #: Each entry: position, digest, epoch, sigs=[(pub, r, s), ...]
-        self._anchors: List[dict] = []
-        self._anchor_target = 0       # newest position already attempted
+        #: A checkpoint-restored engine carries the pre-restart ring
+        #: (store.checkpoint v6 meta) — seed from it so a restarted
+        #: responder serves proofs immediately instead of re-collecting
+        #: at the next boundary.  Fast-forward snapshots serialize an
+        #: empty ring, so adopted engines never donate one.
+        self._anchors: List[dict] = list(
+            getattr(self.core.hg, "restored_anchors", None) or ()
+        )[-ANCHOR_RING:]
+        # newest position already attempted — a restored ring means its
+        # newest entry was already collected; don't re-canvass peers
+        # for a boundary the pre-restart node anchored
+        self._anchor_target = (
+            self._anchors[-1]["position"] if self._anchors else 0
+        )
         self._anchor_collecting = False
         # heartbeat pacing draws from a per-identity seeded stream, not
         # the process-global RNG (found by the consensus-nondeterminism
@@ -916,7 +928,8 @@ class Node:
         loop = asyncio.get_running_loop()
         async with self.core_lock:
             def work():
-                save_checkpoint(self.core.hg, path)
+                save_checkpoint(self.core.hg, path,
+                                anchors=list(self._anchors))
                 if self.core.wal is not None:
                     self.core.wal.checkpointed(self.core.seq, self.core.head)
 

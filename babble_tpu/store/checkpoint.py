@@ -43,7 +43,14 @@ from ..ops.state import DagConfig, DagState, config_from_fields
 #: serialized bytes — pre-v5 checkpoints backfill for free, and a
 #: hostile snapshot cannot smuggle bitplanes inconsistent with the
 #: tables they cache.
-FORMAT_VERSION = 5
+#: v6 adds the attestation anchor ring ("anchors"): the rolling
+#: checkpoint proofs a responder serves to joiners (node/node.py) now
+#: survive restart instead of re-collecting from scratch at the next
+#: boundary.  Compat is one-directional, like the FastForwardResponse
+#: wire form: v6 readers restore v2–v5 checkpoints (the ring backfills
+#: empty), but pre-v6 readers reject v6 bytes at their version gate —
+#: roll out readers before writers when downgrade must stay possible.
+FORMAT_VERSION = 6
 
 _META = "meta.msgpack"
 _DEVICE = "device.npz"
@@ -64,7 +71,19 @@ def _unpack_event(obj: list) -> Event:
     return FullWireEvent.unpack(obj).to_event()
 
 
-def _build_meta(engine: TpuHashgraph) -> dict:
+def _scalar_out(v: int) -> bytes:
+    """256-bit ECDSA scalar as a 32-byte big-endian blob — msgpack
+    ints cap at 64 bits (the PR-8 wire lesson), so anchor signature
+    scalars must ship as bytes."""
+    return int(v).to_bytes(32, "big")
+
+
+def _scalar_in(v) -> int:
+    return int.from_bytes(v, "big") if isinstance(v, (bytes, bytearray)) \
+        else int(v)
+
+
+def _build_meta(engine: TpuHashgraph, anchors=None) -> dict:
     dag = engine.dag
     return {
         "version": FORMAT_VERSION,
@@ -131,6 +150,17 @@ def _build_meta(engine: TpuHashgraph) -> dict:
         "last_committed_round_events": engine.last_committed_round_events,
         "ordered_total": engine._ordered_total,
         "received": sorted(engine._received),
+        # attestation anchor ring (v6): the quorum-signed checkpoint
+        # proofs the node serves to verified-fast-forward joiners.
+        # Node passes its ring on local checkpoints; the fast-forward
+        # snapshot payload serializes an empty ring (a joiner must not
+        # adopt a responder's proof inventory as its own).  Signature
+        # scalars ride as 32-byte blobs, never raw msgpack ints.
+        "anchors": [
+            [a["position"], a["digest"], a["epoch"],
+             [[p, _scalar_out(r), _scalar_out(s)] for p, r, s in a["sigs"]]]
+            for a in (anchors or [])
+        ],
     }
 
 
@@ -158,12 +188,12 @@ def engine_mode(engine) -> str:
 
 
 
-def _build_wide_meta(engine) -> dict:
+def _build_wide_meta(engine, anchors=None) -> dict:
     """WideHashgraph checkpoint meta: the honest meta plus the stream's
     block layout.  The blocked la/fd are NOT re-derivable from the live
     window (entries learned from evicted ancestors survive in the
     rows), so they are first-class checkpoint state, not a cache."""
-    meta = _build_meta(engine)
+    meta = _build_meta(engine, anchors)
     meta["mode"] = "wide"
     meta["n_blocks"] = engine.stream.C
     meta["has_carry"] = engine.stream.carry is not None
@@ -188,23 +218,25 @@ def _build_wide_arrays(engine) -> Dict[str, np.ndarray]:
     return arrays
 
 
-def save_checkpoint(engine, path: str) -> None:
+def save_checkpoint(engine, path: str, anchors=None) -> None:
     """Write a consistent snapshot of `engine` to directory `path`.
     Dispatches on engine type: byzantine (ForkHashgraph) checkpoints are
     host-state-only (the fork pipeline rebuilds device tensors from the
     window every run); wide (WideHashgraph) checkpoints persist the
-    blocked coordinate tensors alongside the host window."""
+    blocked coordinate tensors alongside the host window.  ``anchors``
+    is the node's attestation anchor ring (v6 meta) — engine-less
+    callers may omit it and restore with an empty ring."""
     mode = engine_mode(engine)
     if mode == "byzantine":
         meta = _build_fork_meta(engine)
         arrays = None
     elif mode == "wide":
         engine.flush()
-        meta = _build_wide_meta(engine)
+        meta = _build_wide_meta(engine, anchors)
         arrays = _build_wide_arrays(engine)
     else:
         engine.flush()  # device state must reflect every inserted event
-        meta = _build_meta(engine)
+        meta = _build_meta(engine, anchors)
         arrays = _build_arrays(engine)
 
     tmp = tempfile.mkdtemp(dir=os.path.dirname(os.path.abspath(path)) or ".")
@@ -322,6 +354,18 @@ def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
     ne = len(meta["events"])
     if not (1 <= k <= 8):
         raise ValueError(f"snapshot fork budget k={k} out of bounds")
+    # format header: the restore gate compares version for equality,
+    # but the raw value still feeds error strings and future dispatch —
+    # bound it (and the mode tag) before anything interpolates it
+    ver = meta["version"]
+    if not isinstance(ver, int) or not (0 <= ver <= 1 << 16):
+        raise ValueError(f"snapshot version {ver!r} out of bounds")
+    if meta["mode"] != "byzantine":
+        raise ValueError(
+            f"snapshot mode {meta['mode']!r} is not a fork snapshot"
+        )
+    if not isinstance(meta["verify_signatures"], bool):
+        raise ValueError("snapshot verify_signatures is not a bool")
     # policy knobs are local-overridable but the fallbacks still come
     # from here — bound them so a hostile snapshot can't smuggle a
     # window-freezing round_margin or a never-compacting threshold
@@ -447,8 +491,61 @@ def _check_fork_meta(meta: dict, max_caps: Optional[tuple]) -> None:
                 or not isinstance(eff, int) \
                 or not (-(1 << 63) <= eff < (1 << 63)):
             raise ValueError("snapshot ts_clamped entry malformed")
+    # consensus log + counters: these size the restored OffsetList and
+    # feed lcr/ordering arithmetic — a hostile snapshot must not be
+    # able to allocate unbounded strings or underflow the counters
+    _check_consensus_log(meta["consensus"], wrapped=False)
+    for name, hi in (("consensus_transactions", 1 << 48),
+                     ("last_committed_round_events", 1 << 32)):
+        v = meta[name]
+        if not isinstance(v, int) or not (0 <= v <= hi):
+            raise ValueError(f"snapshot {name}={v!r} out of bounds")
+    _check_received(meta["received"], slots=False)
+    lcr = meta["lcr"]
+    if not isinstance(lcr, int) or not (-1 <= lcr <= 1 << 32):
+        raise ValueError(f"snapshot lcr={lcr!r} out of bounds")
     from ..consensus.digest import CommitDigest
     CommitDigest.check_meta(meta.get("digest"))
+
+
+def _check_consensus_log(cons, wrapped: bool) -> None:
+    """Bounds for the serialized consensus order: host meta wraps it as
+    ``[start, items]`` (OffsetList), fork meta serializes the flat
+    window list.  Entries are event-hash hex strings; both the count
+    and each string's length bound the restore's allocation."""
+    if wrapped:
+        if not isinstance(cons, (list, tuple)) or len(cons) != 2:
+            raise ValueError("snapshot consensus log malformed")
+        start, items = cons
+        if not isinstance(start, int) or not (0 <= start <= 1 << 48):
+            raise ValueError(
+                f"snapshot consensus start {start!r} out of bounds"
+            )
+    else:
+        items = cons
+    if not isinstance(items, (list, tuple)) or len(items) > 1 << 20:
+        raise ValueError("snapshot consensus log out of bounds")
+    for h in items:
+        if not isinstance(h, str) or not (8 <= len(h) <= 128):
+            raise ValueError("snapshot consensus entry malformed")
+
+
+def _check_received(received, slots: bool = True) -> None:
+    """The already-ordered set that seeds ``_received`` and every
+    future dedup comparison.  The fused/wide engines track GLOBAL
+    SLOTS (ints); the fork engine tracks event-hash hex strings
+    (slots are ambiguous under equivocation) — ``slots`` selects the
+    shape, both bounded before they allocate."""
+    if not isinstance(received, (list, tuple)) or len(received) > 1 << 20:
+        raise ValueError("snapshot received set out of bounds")
+    for v in received:
+        if slots:
+            if not isinstance(v, int) or not (0 <= v <= 1 << 48):
+                raise ValueError(
+                    f"snapshot received slot {v!r} out of bounds"
+                )
+        elif not isinstance(v, str) or not (8 <= len(v) <= 128):
+            raise ValueError("snapshot received hash out of bounds")
 
 
 def _check_pending_entry(pend, label: str) -> None:
@@ -603,6 +700,88 @@ def _check_host_meta(meta: dict) -> None:
             raise ValueError(
                 f"snapshot retired columns {retired!r} out of bounds"
             )
+    # format header + engine-mode tag (the byzantine twin never reaches
+    # this checker; load_snapshot dispatched it to _check_fork_meta)
+    ver = meta["version"]
+    if not isinstance(ver, int) or not (0 <= ver <= 1 << 16):
+        raise ValueError(f"snapshot version {ver!r} out of bounds")
+    if not isinstance(meta["verify_signatures"], bool):
+        raise ValueError("snapshot verify_signatures is not a bool")
+    mode = meta.get("mode")
+    if mode not in (None, "wide"):
+        raise ValueError(f"snapshot mode {mode!r} unknown")
+    if mode == "wide":
+        nb = meta["n_blocks"]
+        if not isinstance(nb, int) or not (1 <= nb <= 1 << 16):
+            raise ValueError(f"snapshot n_blocks={nb!r} out of bounds")
+        if not isinstance(meta.get("has_carry", False), bool):
+            raise ValueError("snapshot has_carry is not a bool")
+    # window geometry: slot_base anchors every OffsetList the restore
+    # builds, and the per-slot tables must all match the window length
+    # (the npz twin of this check, _peek_npz_layout, never sees them)
+    base = meta["slot_base"]
+    if not isinstance(base, int) or not (0 <= base <= 1 << 48):
+        raise ValueError(f"snapshot slot_base={base!r} out of bounds")
+    for name in ("levels", "sp_slot", "op_slot", "wire_meta"):
+        if len(meta[name]) != n_events:
+            raise ValueError(
+                f"snapshot field {name} has {len(meta[name])} entries, "
+                f"expected {n_events}"
+            )
+    top = base + n_events
+    for lvl in meta["levels"]:
+        if not isinstance(lvl, int) or not (0 <= lvl <= 1 << 24):
+            raise ValueError(f"snapshot level {lvl!r} out of bounds")
+    for v in meta["sp_slot"] + meta["op_slot"]:
+        # absolute slots on the host path (OffsetList-based), unlike
+        # the window-relative fork encoding
+        if not isinstance(v, int) or not (-1 <= v < max(top, 1)):
+            raise ValueError(f"snapshot parent slot {v!r} out of range")
+    for m in meta["wire_meta"]:
+        if not isinstance(m, (list, tuple)) or len(m) > 16:
+            raise ValueError("snapshot wire_meta entry malformed")
+    _check_consensus_log(meta["consensus"], wrapped=True)
+    for name, hi in (("consensus_transactions", 1 << 48),
+                     ("last_committed_round_events", 1 << 32),
+                     ("ordered_total", 1 << 48)):
+        v = meta[name]
+        if not isinstance(v, int) or not (0 <= v <= hi):
+            raise ValueError(f"snapshot {name}={v!r} out of bounds")
+    _check_received(meta["received"])
+    # attestation anchor ring (v6; absent pre-v6): positions/epochs are
+    # offsets into histories the node will serve proofs against, and
+    # signature scalars are 32-byte blobs (or legacy ints) — all sized
+    # before Node seeds its ring from them
+    anchors = meta.get("anchors", [])
+    if not isinstance(anchors, (list, tuple)) or len(anchors) > 64:
+        raise ValueError("snapshot anchors out of bounds")
+    for a in anchors:
+        if not isinstance(a, (list, tuple)) or len(a) != 4:
+            raise ValueError("snapshot anchor entry malformed")
+        pos, dig, ep, sigs = a
+        if not isinstance(pos, int) or not (0 <= pos <= 1 << 48) \
+                or not isinstance(dig, str) or not (8 <= len(dig) <= 128) \
+                or not isinstance(ep, int) or not (0 <= ep <= 1 << 32):
+            raise ValueError("snapshot anchor entry malformed")
+        if not isinstance(sigs, (list, tuple)) or len(sigs) > 256:
+            raise ValueError("snapshot anchor signatures out of bounds")
+        for s in sigs:
+            if not isinstance(s, (list, tuple)) or len(s) != 3:
+                raise ValueError("snapshot anchor signature malformed")
+            pub, r, sv = s
+            if not isinstance(pub, str) or not (8 <= len(pub) <= 256):
+                raise ValueError("snapshot anchor signer malformed")
+            for scalar in (r, sv):
+                if isinstance(scalar, (bytes, bytearray)):
+                    if len(scalar) > 32:
+                        raise ValueError(
+                            "snapshot anchor scalar out of bounds"
+                        )
+                elif not isinstance(scalar, int) \
+                        or not (0 <= scalar < 1 << 256):
+                    raise ValueError(
+                        "snapshot anchor scalar out of bounds"
+                    )
 
 
 def _pol(policy: dict, key: str, snap_val):
@@ -957,8 +1136,9 @@ def _restore_engine(
     policy: Optional[dict] = None,
 ) -> TpuHashgraph:
     # v2 lacks the coord16 cfg field, v3 the membership-plane fields
-    # (retired cfg column, sm array, epoch ledger) — all default-filled
-    if meta["version"] not in (2, 3, 4, FORMAT_VERSION):
+    # (retired cfg column, sm array, epoch ledger), v5 the anchor ring
+    # — all default-filled
+    if meta["version"] not in (2, 3, 4, 5, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     from ..ops.state import coord8_ok, coord16_ok
     cfg_chk = config_from_fields(meta["cfg"])
@@ -1084,6 +1264,17 @@ def _restore_host(engine, meta: dict) -> None:
         str(pub): str(addr)
         for pub, addr in meta.get("membership_addrs", [])
     }
+    # attestation anchor ring (v6; pre-v6 checkpoints backfill empty —
+    # the node re-collects at its next boundary exactly as before).
+    # Stashed on the engine in Node's in-memory shape; Node.init seeds
+    # its ring from here so a restarted responder can serve proofs for
+    # pre-restart positions immediately.
+    engine.restored_anchors = [
+        {"position": int(a[0]), "digest": str(a[1]), "epoch": int(a[2]),
+         "sigs": [(str(p), _scalar_in(r), _scalar_in(s))
+                  for p, r, s in a[3]]}
+        for a in meta.get("anchors", [])
+    ]
 
 
 def _restore_wide_engine(
@@ -1098,7 +1289,7 @@ def _restore_wide_engine(
     from ..consensus.wide_engine import WideHashgraph
     from ..ops.wide import MarchCarry
 
-    if meta["version"] not in (2, 3, 4, FORMAT_VERSION):
+    if meta["version"] not in (2, 3, 4, 5, FORMAT_VERSION):
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     policy = policy or {}
     participants: Dict[str, int] = {

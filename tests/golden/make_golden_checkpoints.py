@@ -1,0 +1,115 @@
+"""Regenerate the committed golden checkpoint fixtures (v3/v4/v5).
+
+Run from the repo root:
+
+    JAX_PLATFORMS=cpu python tests/golden/make_golden_checkpoints.py
+
+Builds one small deterministic engine (the same construction
+tests/test_golden_checkpoints.py replays), saves a current-format
+checkpoint, and down-converts it to each historical FORMAT_VERSION by
+removing exactly what that version did not yet serialize:
+
+- v5: no ``anchors`` ring (the v6 addition);
+- v4: additionally no ``packed`` cfg flag, no ``mbr``/``fmr`` packed
+  bitplanes, no pipelined-membership / bounded-log keys;
+- v3: additionally no ``retired`` cfg field, no ``sm`` threshold
+  array, no membership plane at all, no commit digest, no eviction
+  horizons, no ts-clamp overrides, and only the 5 original policy
+  knobs.
+
+The fixtures are real bytes restored by real readers — the version
+gates at store/checkpoint.py were previously exercised only by
+same-process round-trips, which can never catch a reader that quietly
+requires a key its own version never wrote."""
+
+import os
+import shutil
+import sys
+import tempfile
+
+import msgpack
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from babble_tpu.consensus.engine import TpuHashgraph          # noqa: E402
+from babble_tpu.sim.generator import random_gossip_dag        # noqa: E402
+from babble_tpu.store import save_checkpoint                  # noqa: E402
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "checkpoints")
+
+#: the deterministic engine both this generator and the tests build
+SPEC = {"n": 3, "n_events": 72, "seed": 11,
+        "e_cap": 128, "s_cap": 48, "r_cap": 32}
+#: events inserted before the checkpoint; the rest extend it
+#: (enough for consensus to be non-empty on BOTH sides of the cut)
+PREFIX = 48
+
+
+def build_engine():
+    dag = random_gossip_dag(SPEC["n"], SPEC["n_events"], seed=SPEC["seed"])
+    eng = TpuHashgraph(
+        dag.participants, verify_signatures=False,
+        e_cap=SPEC["e_cap"], s_cap=SPEC["s_cap"], r_cap=SPEC["r_cap"],
+    )
+    return dag, eng
+
+
+def _downconvert(meta, arrays, version):
+    meta = dict(meta)
+    arrays = dict(arrays)
+    meta["version"] = version
+    meta.pop("anchors", None)                     # v6
+    if version <= 4:
+        meta["cfg"] = meta["cfg"][:9]             # drop `packed`
+        for name in ("mbr", "fmr"):
+            arrays.pop(name, None)
+        for key in ("membership_queue", "membership_base_epoch",
+                    "membership_addrs"):
+            meta.pop(key, None)
+    if version <= 3:
+        meta["cfg"] = meta["cfg"][:8]             # drop `retired`
+        arrays.pop("sm", None)
+        for key in ("epoch", "membership_log", "pending_membership",
+                    "digest", "evicted_heads", "ts_clamped"):
+            meta.pop(key, None)
+        meta["policy"] = meta["policy"][:5]
+    return meta, arrays
+
+
+def main():
+    dag, eng = build_engine()
+    for ev in dag.events[:PREFIX]:
+        eng.insert_event(ev)
+    eng.run_consensus()
+
+    tmp = tempfile.mkdtemp()
+    try:
+        current = os.path.join(tmp, "ckpt")
+        save_checkpoint(eng, current)
+        with open(os.path.join(current, "meta.msgpack"), "rb") as f:
+            meta = msgpack.unpackb(f.read(), raw=False,
+                                   strict_map_key=False)
+        with np.load(os.path.join(current, "device.npz")) as z:
+            arrays = {name: z[name] for name in z.files}
+
+        for version in (3, 4, 5):
+            m, a = _downconvert(meta, arrays, version)
+            out = os.path.join(GOLDEN_DIR, f"v{version}")
+            shutil.rmtree(out, ignore_errors=True)
+            os.makedirs(out)
+            with open(os.path.join(out, "meta.msgpack"), "wb") as f:
+                f.write(msgpack.packb(m, use_bin_type=True))
+            np.savez_compressed(os.path.join(out, "device.npz"), **a)
+            size = sum(
+                os.path.getsize(os.path.join(out, n))
+                for n in os.listdir(out)
+            )
+            print(f"v{version}: {sorted(m)} ({size} bytes)")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""checkpoint-field-coverage fixture: a builder/checker/restore trio
+where the exact partition is broken three ways.
+
+``carry`` is serialized but the checker never bounds it (hostile bytes
+flow straight into the restore); ``epoch`` is checked but no restore
+path ever reads it (dead weight in every checkpoint); the checker
+demands ``budget``, a key no builder writes (every valid checkpoint
+would be rejected).  Exactly three findings, at the MARKed lines."""
+
+FORMAT_VERSION = 3
+
+
+def build_host_meta(engine):
+    return {
+        "version": FORMAT_VERSION,
+        "window": [list(ev) for ev in engine.window],
+        "carry": engine.carry,  # MARK: checkpoint-field-coverage
+        "epoch": engine.epoch,  # MARK: checkpoint-field-coverage
+    }
+
+
+def check_host_meta(meta):
+    ver = meta["version"]
+    if not isinstance(ver, int) or not (0 <= ver <= 1 << 16):
+        raise ValueError("bad version")
+    if not isinstance(meta["window"], list) or len(meta["window"]) > 4096:
+        raise ValueError("bad window")
+    epoch = meta["epoch"]
+    if not isinstance(epoch, int) or epoch < 0:
+        raise ValueError("bad epoch")
+    budget = meta["budget"]  # MARK: checkpoint-field-coverage
+    if not isinstance(budget, int) or budget > 8:
+        raise ValueError("bad budget")
+
+
+def restore_host(engine, meta):
+    engine.version = int(meta["version"])
+    engine.window = [tuple(ev) for ev in meta["window"]]
+    engine.carry = meta["carry"]

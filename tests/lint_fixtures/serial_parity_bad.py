@@ -1,0 +1,104 @@
+"""pack-unpack-parity fixture: four wire pairs, each drifted one way.
+
+DroppedFieldCommand packs a signature scalar the reader never binds
+(the PR-8 shape: the bug only the serialization-free in-memory
+transport tolerated); DriftedReadCommand reads one position past the
+packed arity; BareTailCommand guards position 1 but reads the newer
+tail position bare, so a pre-upgrade payload raises in the reader;
+CarryMeta writes a dict key no reader consumes and reads one no writer
+produces.  Exactly five findings, at the MARKed lines."""
+
+import msgpack
+
+
+class DroppedFieldCommand:
+    """Packs four fields; unpack binds three — sig_s crosses the wire
+    and vanishes."""
+
+    def __init__(self, from_addr, seq, sig_r, sig_s=0):
+        self.from_addr = from_addr
+        self.seq = seq
+        self.sig_r = sig_r
+        self.sig_s = sig_s
+
+    def pack(self):
+        return msgpack.packb([
+            self.from_addr,
+            self.seq,
+            self.sig_r,
+            self.sig_s,  # MARK: pack-unpack-parity
+        ], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        return cls(fields[0], fields[1], fields[2])
+
+
+class DriftedReadCommand:
+    """Reads position 2 of a two-field payload: the read can only bind
+    a foreign field or raise."""
+
+    def __init__(self, from_addr, known):
+        self.from_addr = from_addr
+        self.known = known
+        self.epoch = 0
+
+    def pack(self):
+        return msgpack.packb([
+            self.from_addr,
+            sorted(self.known.items()),
+        ], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        cmd = cls(fields[0], dict(fields[1]))
+        cmd.epoch = fields[2]  # MARK: pack-unpack-parity
+        return cmd
+
+
+class BareTailCommand:
+    """Old peers send one field, upgraded ones three: position 1 is
+    guarded, but the TAIL read of position 2 is bare — the older
+    payload this guard exists for still crashes the reader."""
+
+    def __init__(self, from_addr, position=0, epoch=0):
+        self.from_addr = from_addr
+        self.position = position
+        self.epoch = epoch
+
+    def pack(self):
+        return msgpack.packb([
+            self.from_addr,
+            self.position,
+            self.epoch,
+        ], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        position = fields[1] if len(fields) > 1 else 0
+        epoch = fields[2]  # MARK: pack-unpack-parity
+        return cls(fields[0], position, epoch)
+
+
+class CarryMeta:
+    """Dict pair drifted in both directions: ``carry`` is serialized
+    state that silently vanishes on read, ``tail`` raises on every
+    payload the paired writer produces."""
+
+    def __init__(self, head, tail=0, carry=0):
+        self.head = head
+        self.tail = tail
+        self.carry = carry
+
+    def to_dict(self):
+        return {
+            "head": self.head,
+            "carry": self.carry,  # MARK: pack-unpack-parity
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["head"], d["tail"])  # MARK: pack-unpack-parity

@@ -1,0 +1,81 @@
+"""pack-unpack-parity clean twin: every shape the rule must NOT flag.
+
+ParityCommand reads exactly what it packs; TailGuardedCommand grows a
+tail field behind a length guard (the sanctioned one-directional
+upgrade shape); OptionalMeta reads an optional key with a ``.get``
+default and hands the rest to an absorbing ``cls(**d)`` constructor.
+Zero findings."""
+
+import msgpack
+
+
+class ParityCommand:
+    """Full positional parity: four packed, four read."""
+
+    def __init__(self, from_addr, seq, sig_r, sig_s):
+        self.from_addr = from_addr
+        self.seq = seq
+        self.sig_r = sig_r
+        self.sig_s = sig_s
+
+    def pack(self):
+        return msgpack.packb([
+            self.from_addr,
+            self.seq,
+            self.sig_r,
+            self.sig_s,
+        ], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        return cls(fields[0], fields[1], fields[2], fields[3])
+
+
+class TailGuardedCommand:
+    """The upgrade shape the monotonicity check exists to protect:
+    every read at or past the oldest wire arity sits behind a length
+    guard, so pre-upgrade payloads restore with defaults."""
+
+    def __init__(self, from_addr, position=0, epoch=0):
+        self.from_addr = from_addr
+        self.position = position
+        self.epoch = epoch
+
+    def pack(self):
+        return msgpack.packb([
+            self.from_addr,
+            self.position,
+            self.epoch,
+        ], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        position = fields[1] if len(fields) > 1 else 0
+        epoch = fields[2] if len(fields) > 2 else 0
+        return cls(fields[0], position, epoch)
+
+
+class OptionalMeta:
+    """Keyed pair: ``carry`` is optional on read (explicit default),
+    and the constructor absorbs the remaining keys via ``**``, which
+    vouches for every written key."""
+
+    def __init__(self, head, tail=0, carry=0):
+        self.head = head
+        self.tail = tail
+        self.carry = carry
+
+    def to_dict(self):
+        return {
+            "head": self.head,
+            "tail": self.tail,
+            "carry": self.carry,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        payload = dict(d)
+        payload["carry"] = payload.get("carry", 0)
+        return cls(**payload)

@@ -1,0 +1,62 @@
+"""format-version-ratchet fixture: three ways to dodge the manifest.
+
+The fixtures' committed ``.babble-format-manifest.json`` records
+``RatchetMsg`` WITHOUT its ``epoch`` field and ``build_rot_meta``
+without ``extra`` under an unbumped ``ROT_FORMAT_VERSION`` — so the
+pair fires the stale-manifest finding and the builder fires the
+bump-demand finding; ``UnrecordedMsg`` is not in the manifest at all.
+Exactly three findings, at the MARKed lines.  The pairs themselves
+are parity-clean: the ratchet is the only rule that fires here."""
+
+import msgpack
+
+ROT_FORMAT_VERSION = 2
+
+
+class RatchetMsg:
+    """Grew an ``epoch`` tail field (guarded, so parity is happy) but
+    nobody re-ran --write-format-manifest: the change shipped without
+    review of its wire impact."""
+
+    def __init__(self, from_addr, seq, epoch=0):
+        self.from_addr = from_addr
+        self.seq = seq
+        self.epoch = epoch
+
+    def pack(self):  # MARK: format-version-ratchet
+        return msgpack.packb([
+            self.from_addr,
+            self.seq,
+            self.epoch,
+        ], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        epoch = fields[2] if len(fields) > 2 else 0
+        return cls(fields[0], fields[1], epoch)
+
+
+class UnrecordedMsg:
+    """A whole wire surface the manifest has never heard of."""
+
+    def __init__(self, digest):
+        self.digest = digest
+
+    def pack(self):  # MARK: format-version-ratchet
+        return msgpack.packb([self.digest], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        return cls(fields[0])
+
+
+def build_rot_meta(engine):  # MARK: format-version-ratchet
+    """Added ``extra`` to the checkpoint while ``ROT_FORMAT_VERSION``
+    stayed at 2: old readers cannot tell the formats apart."""
+    return {
+        "version": ROT_FORMAT_VERSION,
+        "head": engine.head,
+        "extra": engine.extra,
+    }

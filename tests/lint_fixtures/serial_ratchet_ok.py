@@ -1,0 +1,36 @@
+"""format-version-ratchet clean twin: the fixtures' committed
+``.babble-format-manifest.json`` records these surfaces exactly as
+written — current field inventories, current ``OK_FORMAT_VERSION``.
+Zero findings."""
+
+import msgpack
+
+OK_FORMAT_VERSION = 3
+
+
+class RecordedMsg:
+    """Wire pair whose manifest entry matches the tree."""
+
+    def __init__(self, from_addr, seq):
+        self.from_addr = from_addr
+        self.seq = seq
+
+    def pack(self):
+        return msgpack.packb([
+            self.from_addr,
+            self.seq,
+        ], use_bin_type=True)
+
+    @classmethod
+    def unpack(cls, data):
+        fields = msgpack.unpackb(data, raw=False)
+        return cls(fields[0], fields[1])
+
+
+def build_ok_meta(engine):
+    """Builder whose inventory and version constant both match the
+    manifest record."""
+    return {
+        "version": OK_FORMAT_VERSION,
+        "head": engine.head,
+    }

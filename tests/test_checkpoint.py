@@ -190,3 +190,123 @@ def test_load_snapshot_rejects_hostile_meta_before_materializing():
     with pytest.raises(ValueError, match="declared"):
         load_snapshot(hostile2, verify_events=False,
                       max_caps=(1 << 22, 1 << 20, 1 << 16))
+
+
+# ----------------------------------------------------------------------
+# attestation anchor ring persistence (FORMAT v6)
+
+
+def _ring():
+    # r rides below 64 bits, s above — both must survive the 32-byte
+    # scalar-blob encoding (msgpack ints cap at 64 bits)
+    return [
+        {"position": 128, "digest": "ab" * 20, "epoch": 2,
+         "sigs": [("c1" * 16, 12345, (1 << 200) + 7),
+                  ("d2" * 16, (1 << 255) - 19, 3)]},
+        {"position": 192, "digest": "cd" * 20, "epoch": 2, "sigs": []},
+    ]
+
+
+def test_anchor_ring_roundtrips_through_checkpoint(tmp_path):
+    """v6: a node's quorum-signed anchor ring survives restart, so a
+    restored responder serves fast-forward proofs immediately."""
+    dag, eng = _build(n=4, n_events=10)
+    ckpt = str(tmp_path / "ckpt")
+    ring = _ring()
+    save_checkpoint(eng, ckpt, anchors=ring)
+    restored = load_checkpoint(ckpt)
+    expect = [
+        {**a, "sigs": [tuple(s) for s in a["sigs"]]} for a in ring
+    ]
+    assert restored.restored_anchors == expect
+
+    # default save (no ring passed) restores an empty ring
+    bare = str(tmp_path / "bare")
+    save_checkpoint(eng, bare)
+    assert load_checkpoint(bare).restored_anchors == []
+
+
+def test_node_seeds_anchor_ring_from_restored_engine(tmp_path):
+    from babble_tpu.crypto.keys import generate_key
+    from babble_tpu.net.inmem_transport import InmemNetwork
+    from babble_tpu.net.peers import Peer
+    from babble_tpu.node import Core
+    from babble_tpu.node.config import Config
+    from babble_tpu.node.node import Node
+    from babble_tpu.proxy.inmem import InmemAppProxy
+
+    keys = sorted([generate_key() for _ in range(2)], key=lambda k: k.pub_hex)
+    participants = {k.pub_hex: i for i, k in enumerate(keys)}
+    core = Core(0, keys[0], participants, e_cap=64)
+    core.init()
+
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(core.hg, ckpt, anchors=_ring())
+
+    net = InmemNetwork()
+    peers = [Peer(net_addr=f"inmem://ring{i}", pub_key_hex=k.pub_hex)
+             for i, k in enumerate(keys)]
+    node = Node(Config.test_config(), keys[0], peers,
+                net.transport(peers[0].net_addr), InmemAppProxy(),
+                engine=load_checkpoint(ckpt))
+    assert [a["position"] for a in node._anchors] == [128, 192]
+    # the newest restored position was already collected pre-restart:
+    # the node must not re-canvass peers for that boundary
+    assert node._anchor_target == 192
+
+
+def test_pre_v6_meta_restores_with_empty_ring(tmp_path):
+    import msgpack
+
+    dag, eng = _build(n=4, n_events=10)
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(eng, ckpt, anchors=_ring())
+    meta_path = tmp_path / "ckpt" / "meta.msgpack"
+    meta = msgpack.unpackb(meta_path.read_bytes(), raw=False,
+                           strict_map_key=False)
+    meta["version"] = 5
+    del meta["anchors"]
+    meta_path.write_bytes(msgpack.packb(meta, use_bin_type=True))
+    restored = load_checkpoint(ckpt)
+    assert restored.restored_anchors == []
+    assert restored.known() == eng.known()
+
+
+_SIG = ["c1" * 16, b"\x01" * 32, b"\x02" * 32]
+
+
+@pytest.mark.parametrize("ring, msg", [
+    ([[128, "ab" * 20, 2, []]] * 65, "anchors out of bounds"),
+    ([[128, "ab" * 20, 2]], "anchor entry malformed"),
+    ([[-1, "ab" * 20, 2, []]], "anchor entry malformed"),
+    ([[128, "ab", 2, []]], "anchor entry malformed"),
+    ([[128, "ab" * 20, 2, [_SIG] * 257]], "signatures out of bounds"),
+    ([[128, "ab" * 20, 2, [["xy", 1, 2]]]], "anchor signer malformed"),
+    ([[128, "ab" * 20, 2, [["c1" * 16, b"\xff" * 33, 2]]]],
+     "scalar out of bounds"),
+    # msgpack ints cap at 64 bits, so an int scalar can only violate
+    # the bound from below
+    ([[128, "ab" * 20, 2, [["c1" * 16, 1, -1]]]],
+     "scalar out of bounds"),
+])
+def test_snapshot_rejects_hostile_anchor_ring(ring, msg):
+    """The fast-forward snapshot serializes an EMPTY ring by design (a
+    joiner must not adopt a responder's proof inventory), so any
+    non-trivial ring in a snapshot is a hostile responder — every
+    field is bounds-checked in _check_host_meta before any object is
+    built from it."""
+    import msgpack
+
+    from babble_tpu.store.checkpoint import load_snapshot, snapshot_bytes
+
+    dag, eng = _build(n=4, n_events=10)
+    snap = snapshot_bytes(eng)
+    meta_b, npz_b = msgpack.unpackb(snap, raw=False)
+    meta = msgpack.unpackb(meta_b, raw=False, strict_map_key=False)
+    assert meta["anchors"] == []      # the by-design empty ring
+    meta["anchors"] = ring
+    hostile = msgpack.packb(
+        [msgpack.packb(meta, use_bin_type=True), npz_b], use_bin_type=True
+    )
+    with pytest.raises(ValueError, match=msg):
+        load_snapshot(hostile, verify_events=False)

@@ -220,7 +220,8 @@ def test_checkpoint_v5_packed_roundtrip_and_v4_backfill(tmp_path):
     """v5 checkpoints carry the bitplanes and restore them consistent
     with the wide tensors (restore re-packs rather than trusts); a
     pre-v5 checkpoint — no mbr/fmr arrays, 9-field cfg — still loads,
-    backfilled by re-packing.  The version bump is pinned."""
+    backfilled by re-packing.  The bitplanes landed in v5; later bumps
+    (v6 anchors) keep the invariant."""
     import msgpack
 
     from babble_tpu.store.checkpoint import (
@@ -229,7 +230,7 @@ def test_checkpoint_v5_packed_roundtrip_and_v4_backfill(tmp_path):
         save_checkpoint,
     )
 
-    assert FORMAT_VERSION == 5
+    assert FORMAT_VERSION >= 5
 
     dag = random_gossip_dag(4, 120, seed=3)
     eng, _ = _stream(dag, 8, kernel_class="latency", finality_gate=True)

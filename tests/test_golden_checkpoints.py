@@ -1,0 +1,111 @@
+"""Golden checkpoint fixtures: committed REAL bytes for FORMAT v3/v4/v5.
+
+The version gates in store/checkpoint.py were previously exercised
+only by same-process round-trips — save with today's writer, load with
+today's reader — which can never catch a reader that quietly starts
+requiring a meta key its own version never wrote.  These tests restore
+the committed historical bytes with the current reader and then EXTEND
+the restored engine alongside a never-checkpointed twin, so both the
+default-backfill paths (``_backfill_sm``, ``_backfill_packed``, the
+``.get``-defaulted meta keys, the truncated-cfg padding) and the
+post-restore consensus behaviour are pinned.
+
+Regenerate with ``python tests/golden/make_golden_checkpoints.py``
+only alongside a deliberate compatibility change.
+"""
+
+import os
+import shutil
+
+import msgpack
+import numpy as np
+import pytest
+
+from babble_tpu.store import load_checkpoint, save_checkpoint
+from babble_tpu.store.checkpoint import FORMAT_VERSION
+from tests.golden.make_golden_checkpoints import (
+    GOLDEN_DIR,
+    PREFIX,
+    build_engine,
+)
+
+GOLDEN_VERSIONS = (3, 4, 5)
+
+
+def _golden(version):
+    path = os.path.join(GOLDEN_DIR, f"v{version}")
+    assert os.path.isfile(os.path.join(path, "meta.msgpack")), (
+        f"missing committed golden fixture {path}; run "
+        "tests/golden/make_golden_checkpoints.py"
+    )
+    return path
+
+
+def _meta(path):
+    with open(os.path.join(path, "meta.msgpack"), "rb") as f:
+        return msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+
+
+@pytest.mark.parametrize("version", GOLDEN_VERSIONS)
+def test_golden_fixture_claims_its_version(version):
+    meta = _meta(_golden(version))
+    assert meta["version"] == version
+    assert "anchors" not in meta          # the ring is the v6 addition
+
+
+@pytest.mark.parametrize("version", GOLDEN_VERSIONS)
+def test_golden_restore_and_extend_parity(version):
+    """The committed v3/v4/v5 bytes restore under the current reader
+    and then reach the same consensus as an engine that never
+    stopped."""
+    dag, twin = build_engine()
+    for ev in dag.events[:PREFIX]:
+        twin.insert_event(ev)
+    twin.run_consensus()
+
+    restored = load_checkpoint(_golden(version))
+    assert restored.consensus_events() == twin.consensus_events()
+
+    for ev in dag.events[PREFIX:]:
+        twin.insert_event(ev.clone())
+        restored.insert_event(ev.clone())
+    twin.run_consensus()
+    restored.run_consensus()
+
+    assert restored.consensus_events() == twin.consensus_events()
+    assert len(restored.consensus_events()) > 0
+    assert restored.known() == twin.known()
+
+
+@pytest.mark.parametrize("version", GOLDEN_VERSIONS)
+def test_golden_resave_upgrades_to_current_format(version):
+    """Restoring a historical checkpoint and re-saving writes
+    current-format bytes — the upgrade path is restore + save, never
+    in-place mutation of old bytes."""
+    restored = load_checkpoint(_golden(version))
+    out = os.path.join("/tmp", f"golden-upgrade-v{version}")
+    shutil.rmtree(out, ignore_errors=True)
+    try:
+        save_checkpoint(restored, out)
+        meta = _meta(out)
+        assert meta["version"] == FORMAT_VERSION
+        assert "anchors" in meta
+        again = load_checkpoint(out)
+        assert again.consensus_events() == restored.consensus_events()
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+
+
+def test_unknown_future_version_is_rejected(tmp_path):
+    """The gate that made FastForwardResponse one-directional: a
+    pre-v6-style reader (any reader) refuses bytes from a version it
+    does not know, rather than guessing at the schema."""
+    src = _golden(5)
+    dst = tmp_path / "ckpt"
+    shutil.copytree(src, dst)
+    meta = _meta(str(dst))
+    meta["version"] = FORMAT_VERSION + 1
+    (dst / "meta.msgpack").write_bytes(
+        msgpack.packb(meta, use_bin_type=True))
+    with pytest.raises(ValueError, match="unsupported checkpoint version"):
+        load_checkpoint(str(dst))

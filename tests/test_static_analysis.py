@@ -77,8 +77,9 @@ def test_rule_catalog_well_formed():
         assert r.description, f"rule {r.name} has no description"
     # the ISSUE-1 rule families, the ISSUE-2 blocking-call rule, the
     # ISSUE-3 chaos-reproducibility rule, the ISSUE-4 project-wide
-    # flow-aware rules, the ISSUE-12 device-plane family, and the
-    # ISSUE-16 trust-boundary/parity families
+    # flow-aware rules, the ISSUE-12 device-plane family, the
+    # ISSUE-16 trust-boundary/parity families, and the ISSUE-19
+    # serialization-plane family
     assert {"jit-traced-branch", "jit-host-sync", "jit-unhashable-static",
             "await-state-race", "asyncio-blocking-call",
             "drain-before-validate", "falsy-or-fallback",
@@ -87,7 +88,9 @@ def test_rule_catalog_well_formed():
             "donate-use-after-free", "recompile-hazard",
             "partition-spec-coverage",
             "bytes-model-coverage",
-            "unbounded-hostile-input", "engine-parity"} <= set(names)
+            "unbounded-hostile-input", "engine-parity",
+            "pack-unpack-parity", "checkpoint-field-coverage",
+            "format-version-ratchet"} <= set(names)
 
 
 def test_every_suppression_in_tree_names_a_rule():
@@ -796,3 +799,303 @@ def test_cli_sarif_carries_new_rules():
     for rule in ("unbounded-hostile-input", "engine-parity"):
         assert rule in rule_ids, sorted(rule_ids)
         assert rule in result_ids, sorted(result_ids)
+
+# ----------------------------------------------------------------------
+# ISSUE-19: serialization-plane schema lint
+
+
+def test_serial_parity_fixture_findings():
+    """Every drift direction of a pack/unpack pair: a packed field the
+    reader never binds, a read past the packed arity, an unguarded
+    tail read above a guarded position, a dict key that vanishes on
+    read and one the writer never produces; the clean twin's guarded
+    tails, .get defaults and **-absorbing constructor stay clean."""
+    path = _fixture("serial_parity_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "pack-unpack-parity") == _marked_lines(
+        path, "pack-unpack-parity"
+    ), [f.format() for f in findings]
+    assert len(findings) == 5, [f.format() for f in findings]
+
+    ok = check_file(_fixture("serial_parity_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_serial_coverage_fixture_findings():
+    """The exact-partition contract on builder/checker/restore trios:
+    a key the checker never bounds, a key no restore path reads, and a
+    checker demanding a key no builder writes all fire; the twin whose
+    every key is bounded and restored (with a .get backfill for the
+    versioned tail key) stays clean."""
+    path = _fixture("serial_coverage_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "checkpoint-field-coverage") == (
+        _marked_lines(path, "checkpoint-field-coverage")
+    ), [f.format() for f in findings]
+    assert len(findings) == 3, [f.format() for f in findings]
+
+    ok = check_file(_fixture("serial_coverage_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_serial_ratchet_fixture_findings():
+    """The fixtures' committed manifest deliberately records stale
+    inventories for serial_ratchet_bad: a pair that grew a field, a
+    builder that grew one under an unbumped constant (the bump-demand
+    flavor names the constant), and a surface never recorded at all;
+    the accurately-recorded twin stays clean."""
+    path = _fixture("serial_ratchet_bad.py")
+    findings = check_file(path, ALL_RULES, known_rules=RULE_NAMES)
+    assert _found_lines(findings, "format-version-ratchet") == (
+        _marked_lines(path, "format-version-ratchet")
+    ), [f.format() for f in findings]
+    assert len(findings) == 3, [f.format() for f in findings]
+    messages = " | ".join(f.message for f in findings)
+    assert "without bumping `ROT_FORMAT_VERSION`" in messages
+    assert "not recorded in the format manifest" in messages
+
+    ok = check_file(_fixture("serial_ratchet_ok.py"), ALL_RULES,
+                    known_rules=RULE_NAMES)
+    assert ok == [], [f.format() for f in ok]
+
+
+def test_format_manifest_committed_and_matches_tree():
+    """The repo-root .babble-format-manifest.json is the reviewable
+    record of every serialized surface: it must exist and equal the
+    inventory recomputed from the tree byte for byte — a drifted or
+    hand-edited manifest fails tier-1 even where the ratchet rule
+    itself would stay quiet (e.g. a whole module deleted)."""
+    from babble_tpu.analysis.serial import (
+        MANIFEST_NAME, compute_surfaces, load_manifest, manifest_entry,
+    )
+
+    mpath = os.path.join(REPO, MANIFEST_NAME)
+    assert os.path.isfile(mpath), "format manifest is not committed"
+    recorded, err = load_manifest(mpath)
+    assert err is None, err
+    computed = {
+        name: manifest_entry(s, REPO)
+        for name, s in compute_surfaces([PKG]).items()
+    }
+    assert recorded == computed
+    # the surfaces the ISSUE names are actually under the ratchet
+    for name in ("wire:babble_tpu.net.commands:FastForwardResponse",
+                 "meta:babble_tpu.store.checkpoint:_build_meta",
+                 "meta:babble_tpu.store.checkpoint:_build_fork_meta",
+                 "frame:babble_tpu.wal.log:_HDR",
+                 "manifest:babble_tpu.ops.aot:ENGINE_CACHE_VERSION"):
+        assert name in recorded, sorted(recorded)
+
+
+def test_serial_families_clean_on_tree_with_zero_suppressions():
+    """All three new families pass the real tree with ZERO waivers:
+    the live coverage gaps they surfaced (unbounded consensus/received
+    payloads in both checkers, the unbounded anchors ring) are fixed
+    in checkpoint.py, not suppressed."""
+    new = [f for f in _tree_findings()
+           if f.rule in ("pack-unpack-parity", "checkpoint-field-coverage",
+                         "format-version-ratchet")]
+    assert new == [], [f.format() for f in new]
+
+
+def test_meta_field_add_demo(tmp_path):
+    """The acceptance demo, end to end in a throwaway tree: adding a
+    checkpoint meta field fails lint at the coverage AND ratchet
+    families, --write-format-manifest REFUSES while the version
+    constant is unbumped, and only bounds + restore backfill + bump +
+    re-record bring the tree back to clean."""
+    import shutil
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    shutil.copy(_fixture("serial_coverage_ok.py"), tree / "ckpt.py")
+    manifest = tree / ".babble-format-manifest.json"
+    manifest.write_text('{"version": 1, "surfaces": {}}\n',
+                        encoding="utf-8")
+    wrote = _run_cli("--write-format-manifest", str(tree))
+    assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+    assert str(manifest) in wrote.stderr
+    assert _run_cli(str(tree)).returncode == 0
+
+    src = (tree / "ckpt.py").read_text(encoding="utf-8")
+    src = src.replace('"carry": engine.carry,',
+                      '"carry": engine.carry,\n'
+                      '        "horizon": engine.horizon,')
+    (tree / "ckpt.py").write_text(src, encoding="utf-8")
+
+    broken = _run_cli(str(tree))
+    assert broken.returncode == 1, broken.stdout + broken.stderr
+    assert "checkpoint-field-coverage" in broken.stdout
+    assert "format-version-ratchet" in broken.stdout
+    assert "horizon" in broken.stdout
+
+    # the sanctioned bump path refuses while the constant is unbumped
+    refused = _run_cli("--write-format-manifest", str(tree))
+    assert refused.returncode == 2, refused.stdout + refused.stderr
+    assert "unbumped version constant" in refused.stderr
+    assert _run_cli(str(tree)).returncode == 1  # nothing was recorded
+
+    # bounds + restore backfill + version bump...
+    src = src.replace("FORMAT_VERSION = 4", "FORMAT_VERSION = 5")
+    src = src.replace(
+        '    anchors = meta.get("anchors", [])',
+        '    horizon = meta.get("horizon", 0)\n'
+        '    if not isinstance(horizon, int) or horizon < 0:\n'
+        '        raise ValueError("bad horizon")\n'
+        '    anchors = meta.get("anchors", [])',
+    )
+    src += '\n\ndef restore_horizon(engine, meta):\n' \
+           '    engine.horizon = int(meta.get("horizon", 0))\n'
+    (tree / "ckpt.py").write_text(src, encoding="utf-8")
+
+    # ...still fails until the manifest records the new inventory
+    stale = _run_cli(str(tree))
+    assert stale.returncode == 1, stale.stdout + stale.stderr
+    assert "format-version-ratchet" in stale.stdout
+    assert "checkpoint-field-coverage" not in stale.stdout
+
+    rerec = _run_cli("--write-format-manifest", str(tree))
+    assert rerec.returncode == 0, rerec.stdout + rerec.stderr
+    assert _run_cli(str(tree)).returncode == 0
+
+
+def test_msgpack_reorder_demo(tmp_path):
+    """Reordering positional msgpack fields keeps pack/unpack parity
+    happy (every position still reads) but the ratchet catches the
+    silent wire break: the recorded inventory is order-sensitive."""
+    import shutil
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    shutil.copy(_fixture("serial_parity_ok.py"), tree / "wire.py")
+    (tree / ".babble-format-manifest.json").write_text(
+        '{"version": 1, "surfaces": {}}\n', encoding="utf-8")
+    assert _run_cli("--write-format-manifest", str(tree)).returncode == 0
+    assert _run_cli(str(tree)).returncode == 0
+
+    src = (tree / "wire.py").read_text(encoding="utf-8")
+    block = ("            self.from_addr,\n"
+             "            self.seq,\n"
+             "            self.sig_r,\n"
+             "            self.sig_s,\n")
+    assert block in src
+    src = src.replace(block,
+                      "            self.seq,\n"
+                      "            self.from_addr,\n"
+                      "            self.sig_r,\n"
+                      "            self.sig_s,\n")
+    (tree / "wire.py").write_text(src, encoding="utf-8")
+
+    proc = _run_cli(str(tree))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "format-version-ratchet" in proc.stdout
+    assert "reordered" in proc.stdout
+    assert "pack-unpack-parity" not in proc.stdout
+
+
+def test_manifest_edit_invalidates_cache(tmp_path):
+    """The whole-run cache must key on every manifest that could
+    shadow a linted file: editing the manifest alone (no source file
+    touched) is a miss and the ratchet re-fires."""
+    import shutil
+
+    from babble_tpu.analysis import run_paths_cached
+    from babble_tpu.analysis.serial import compute_surfaces, write_manifest
+
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    shutil.copy(_fixture("serial_ratchet_ok.py"), tree / "wire.py")
+    manifest = tree / ".babble-format-manifest.json"
+    manifest.write_text('{"version": 1, "surfaces": {}}\n',
+                        encoding="utf-8")
+    assert write_manifest(str(manifest),
+                          compute_surfaces([str(tree)])) == []
+    cache_file = str(tmp_path / "lint.cache")
+
+    cold, hit = run_paths_cached([str(tree)], ALL_RULES, cache_file,
+                                 known_rules=RULE_NAMES)
+    assert hit is False and cold == []
+    warm, hit = run_paths_cached([str(tree)], ALL_RULES, cache_file,
+                                 known_rules=RULE_NAMES)
+    assert hit is True and warm == []
+
+    doc = json.loads(manifest.read_text(encoding="utf-8"))
+    doc["surfaces"]["wire:wire:RecordedMsg"]["fields"] = ["from_addr"]
+    manifest.write_text(json.dumps(doc), encoding="utf-8")
+    edited, hit = run_paths_cached([str(tree)], ALL_RULES, cache_file,
+                                   known_rules=RULE_NAMES)
+    assert hit is False
+    assert {f.rule for f in edited} == {"format-version-ratchet"}, [
+        f.format() for f in edited
+    ]
+
+
+def test_cli_changed_scopes_reporting(tmp_path):
+    """--changed on a throwaway git repo: a finding in a committed,
+    untouched file is filtered out of the report while the same run
+    without --changed still fails; a new (untracked) file with a
+    finding brings the flag back to exit 1; outside git it is a loud
+    usage error, never a silently-empty report."""
+    import shutil
+
+    repo = tmp_path / "wt"
+    repo.mkdir()
+    shutil.copy(_fixture("guard_bad.py"), repo / "old.py")
+
+    def run(*args, cwd):
+        env = dict(os.environ, PYTHONPATH=REPO)
+        return subprocess.run(
+            [sys.executable, "-m", "babble_tpu.analysis", *args],
+            cwd=str(cwd), capture_output=True, text=True, timeout=120,
+            env=env,
+        )
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=str(repo), capture_output=True, text=True, check=True,
+        )
+
+    # outside a git checkout the flag is a usage error
+    assert run("--changed", ".", cwd=repo).returncode == 2
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    full = run(".", cwd=repo)
+    assert full.returncode == 1, full.stdout + full.stderr
+    scoped = run("--changed", ".", cwd=repo)
+    assert scoped.returncode == 0, scoped.stdout + scoped.stderr
+
+    shutil.copy(_fixture("invariants_bad.py"), repo / "new.py")
+    touched = run("--changed", ".", cwd=repo)
+    assert touched.returncode == 1, touched.stdout + touched.stderr
+    assert "new.py" in touched.stdout
+    assert "old.py" not in touched.stdout
+
+
+def test_cli_streams_carry_serial_rules():
+    """--json and --sarif both carry the three new families end to
+    end: catalog entries in the SARIF driver, findings in both
+    streams."""
+    serial_rules = {"pack-unpack-parity", "checkpoint-field-coverage",
+                    "format-version-ratchet"}
+    proc = _run_cli("--sarif", FIXTURES)
+    assert proc.returncode == 1
+    run = json.loads(proc.stdout)["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    result_ids = {r["ruleId"] for r in run["results"]}
+    assert serial_rules <= rule_ids, sorted(rule_ids)
+    assert serial_rules <= result_ids, sorted(result_ids)
+
+    rows = []
+    for name in ("serial_parity_bad.py", "serial_coverage_bad.py",
+                 "serial_ratchet_bad.py"):
+        jp = _run_cli("--json", _fixture(name))
+        assert jp.returncode == 1
+        rows += [json.loads(line) for line in jp.stdout.splitlines()
+                 if line]
+    assert {r["rule"] for r in rows} == serial_rules
